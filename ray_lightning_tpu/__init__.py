@@ -1,0 +1,58 @@
+"""ray_lightning_tpu — a TPU-native training framework.
+
+A ground-up rebuild of the capabilities of `ray_lightning`
+(aced125/ray_lightning: Lightning-on-Ray distributed training plugins) as
+an idiomatic JAX/XLA framework: Lightning-style Module/Trainer, sharding
+strategies over a `jax.sharding.Mesh` (DP / FSDP / tensor / sequence
+parallel), a multi-host runtime substrate, sharded checkpointing, and a
+Tune-style HPO sweep layer — no torch, no NCCL, no Ray in the loop.
+"""
+from ray_lightning_tpu.core import (
+    Callback,
+    DataLoader,
+    DataModule,
+    EarlyStopping,
+    ModelCheckpoint,
+    ProgressLogger,
+    ThroughputMonitor,
+    TpuModule,
+    TrainState,
+    Trainer,
+)
+from ray_lightning_tpu.parallel import (
+    DataParallel,
+    FSDP,
+    MeshSpec,
+    RayXlaPlugin,
+    ShardedMesh,
+    SingleDevice,
+    Strategy,
+    make_mesh,
+)
+from ray_lightning_tpu.utils import seed_everything, simulate_cpu_devices
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "TpuModule",
+    "Trainer",
+    "TrainState",
+    "DataLoader",
+    "DataModule",
+    "Callback",
+    "EarlyStopping",
+    "ModelCheckpoint",
+    "ProgressLogger",
+    "ThroughputMonitor",
+    "Strategy",
+    "DataParallel",
+    "FSDP",
+    "ShardedMesh",
+    "SingleDevice",
+    "RayXlaPlugin",
+    "MeshSpec",
+    "make_mesh",
+    "seed_everything",
+    "simulate_cpu_devices",
+    "__version__",
+]

@@ -1,0 +1,7 @@
+from ray_lightning_tpu.checkpoint.io import (
+    save_checkpoint,
+    load_checkpoint,
+    restore_checkpoint,
+)
+
+__all__ = ["save_checkpoint", "load_checkpoint", "restore_checkpoint"]

@@ -1,0 +1,24 @@
+from ray_lightning_tpu.core.module import TpuModule
+from ray_lightning_tpu.core.trainer import Trainer
+from ray_lightning_tpu.core.state import TrainState
+from ray_lightning_tpu.core.data import DataLoader, DataModule
+from ray_lightning_tpu.core.callbacks import (
+    Callback,
+    EarlyStopping,
+    ModelCheckpoint,
+    ProgressLogger,
+    ThroughputMonitor,
+)
+
+__all__ = [
+    "TpuModule",
+    "Trainer",
+    "TrainState",
+    "DataLoader",
+    "DataModule",
+    "Callback",
+    "EarlyStopping",
+    "ModelCheckpoint",
+    "ProgressLogger",
+    "ThroughputMonitor",
+]

@@ -1,0 +1,203 @@
+"""Callback bus: EarlyStopping, ModelCheckpoint, progress/throughput logging.
+
+The reference leaned on PTL for all of these and only *transported* their
+effects (rank-0 best_model_path round-trip, ray_ddp.py:186-193,280-291;
+checkpoint hooks verified by test_early_stop, reference tests/utils.py:89-93,
+tests/test_ddp.py:116-132). The rebuild owns them.
+"""
+from __future__ import annotations
+
+import math
+import os
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ray_lightning_tpu.utils import get_logger
+
+log = get_logger(__name__)
+
+
+class Callback:
+    def on_fit_start(self, trainer, module) -> None: ...
+    def on_fit_end(self, trainer, module) -> None: ...
+    def on_train_epoch_start(self, trainer, module) -> None: ...
+    def on_train_batch_end(self, trainer, module, metrics: Dict[str, Any],
+                           batch_idx: int) -> None: ...
+    def on_train_epoch_end(self, trainer, module) -> None: ...
+    def on_validation_epoch_end(self, trainer, module,
+                                metrics: Dict[str, Any]) -> None: ...
+    def on_save_checkpoint(self, trainer, module, checkpoint: dict) -> None: ...
+    def on_load_checkpoint(self, trainer, module, checkpoint: dict) -> None: ...
+    def on_exception(self, trainer, module, exc: BaseException) -> None: ...
+
+
+class EarlyStopping(Callback):
+    """Stop when `monitor` stops improving (PTL-compatible surface)."""
+
+    def __init__(self, monitor: str = "val_loss", patience: int = 3,
+                 mode: str = "min", min_delta: float = 0.0):
+        assert mode in ("min", "max")
+        self.monitor = monitor
+        self.patience = patience
+        self.mode = mode
+        self.min_delta = min_delta
+        self.best = math.inf if mode == "min" else -math.inf
+        self.wait = 0
+
+    def _improved(self, value: float) -> bool:
+        if self.mode == "min":
+            return value < self.best - self.min_delta
+        return value > self.best + self.min_delta
+
+    def _check(self, trainer, metrics: Dict[str, Any]) -> None:
+        if self.monitor not in metrics:
+            return
+        value = float(metrics[self.monitor])
+        if self._improved(value):
+            self.best = value
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                log.info("EarlyStopping: %s=%g (best %g), stopping",
+                         self.monitor, value, self.best)
+                trainer.should_stop = True
+
+    def on_validation_epoch_end(self, trainer, module, metrics) -> None:
+        self._check(trainer, metrics)
+
+    def on_train_epoch_end(self, trainer, module) -> None:
+        if not trainer.has_validation:
+            self._check(trainer, trainer.callback_metrics)
+
+
+class ModelCheckpoint(Callback):
+    """Track-and-save the best (and/or last) checkpoint.
+
+    After fit, `best_model_path` is readable on the driver — the reference
+    shipped this string from worker rank 0 (ray_ddp.py:186-193); here the
+    trainer owns the loop so it is simply set in place.
+    """
+
+    def __init__(self, dirpath: Optional[str] = None, monitor: Optional[str] = None,
+                 mode: str = "min", save_top_k: int = 1, save_last: bool = False,
+                 every_n_epochs: int = 1, filename: str = "epoch={epoch}"):
+        self.dirpath = dirpath
+        self.monitor = monitor
+        self.mode = mode
+        self.save_top_k = save_top_k
+        self.save_last = save_last
+        self.every_n_epochs = max(1, every_n_epochs)
+        self.filename = filename
+        self.best_model_path: str = ""
+        self.best_model_score: Optional[float] = None
+        self.last_model_path: str = ""
+        self._saved: list[tuple[float, str]] = []  # (score, path)
+
+    def _resolve_dir(self, trainer) -> str:
+        d = self.dirpath or os.path.join(trainer.default_root_dir, "checkpoints")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def _score(self, metrics: Dict[str, Any]) -> Optional[float]:
+        if self.monitor is None:
+            return None
+        if self.monitor not in metrics:
+            return None
+        return float(metrics[self.monitor])
+
+    def _maybe_save(self, trainer, module, metrics: Dict[str, Any]) -> None:
+        if trainer.current_epoch % self.every_n_epochs != 0:
+            return
+        d = self._resolve_dir(trainer)
+        name = self.filename.format(epoch=trainer.current_epoch,
+                                    step=trainer.global_step)
+        path = os.path.join(d, name)
+        score = self._score(metrics)
+        if self.monitor is not None and score is None:
+            return  # monitored metric absent this epoch
+        trainer.save_checkpoint(path)
+        if self.save_last:
+            self.last_model_path = path
+        if self.monitor is None:
+            # Unmonitored: "best" is the most recent; prune to save_top_k.
+            self.best_model_path = path
+            self._saved.append((-float(trainer.global_step), path))
+            self._prune()
+            return
+        sign = 1.0 if self.mode == "min" else -1.0
+        self._saved.append((sign * score, path))
+        if self.best_model_score is None or sign * score < sign * self.best_model_score:
+            self.best_model_score = score
+            self.best_model_path = path
+        self._prune()
+
+    def _prune(self) -> None:
+        if self.save_top_k <= 0:
+            return
+        self._saved.sort(key=lambda t: t[0])
+        for _, stale in self._saved[self.save_top_k:]:
+            if stale not in (self.best_model_path, self.last_model_path):
+                _rmtree_quiet(stale)
+        self._saved = self._saved[: self.save_top_k]
+
+    def on_validation_epoch_end(self, trainer, module, metrics) -> None:
+        self._maybe_save(trainer, module, metrics)
+
+    def on_train_epoch_end(self, trainer, module) -> None:
+        if not trainer.has_validation:
+            self._maybe_save(trainer, module, trainer.callback_metrics)
+
+
+class ThroughputMonitor(Callback):
+    """Step-time / examples-per-sec — the §5.5 gap in the reference (it had
+    no system metrics at all). Feeds trainer.callback_metrics."""
+
+    def __init__(self, window: int = 20):
+        self.window = window
+        self._times: list[float] = []
+        self._t0: Optional[float] = None
+
+    def on_train_epoch_start(self, trainer, module) -> None:
+        self._t0 = time.perf_counter()
+
+    def on_train_batch_end(self, trainer, module, metrics, batch_idx) -> None:
+        t = time.perf_counter()
+        if self._t0 is not None:
+            self._times.append(t - self._t0)
+            self._times = self._times[-self.window:]
+        self._t0 = t
+        if self._times:
+            step_time = float(np.mean(self._times))
+            trainer.callback_metrics["step_time_s"] = step_time
+            bs = trainer.last_batch_size
+            if bs:
+                trainer.callback_metrics["examples_per_sec"] = bs / step_time
+
+
+class ProgressLogger(Callback):
+    """Console progress (the reference inherited PTL's bar; headless here)."""
+
+    def __init__(self, log_every_n_steps: int = 50):
+        self.every = max(1, log_every_n_steps)
+
+    def on_train_batch_end(self, trainer, module, metrics, batch_idx) -> None:
+        if trainer.global_step % self.every == 0:
+            pretty = {k: (f"{float(v):.4g}" if np.ndim(v) == 0 else "…")
+                      for k, v in metrics.items()}
+            log.info("epoch %d step %d %s", trainer.current_epoch,
+                     trainer.global_step, pretty)
+
+
+def _rmtree_quiet(path: str) -> None:
+    import shutil
+
+    try:
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.remove(path)
+    except OSError:
+        pass

@@ -1,0 +1,29 @@
+"""Training state pytree: the single donated argument of the jitted step."""
+from __future__ import annotations
+
+from typing import Any
+
+import flax.struct
+import jax.numpy as jnp
+import optax
+
+
+@flax.struct.dataclass
+class TrainState:
+    """Everything that evolves across steps, as one pytree.
+
+    The whole state is donated to the jitted train step so XLA updates
+    params/opt-state in place in HBM (no copy per step).
+    """
+
+    step: jnp.ndarray  # scalar int32
+    params: Any
+    opt_state: Any
+
+    @classmethod
+    def create(cls, params: Any, tx: optax.GradientTransformation) -> "TrainState":
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+        )

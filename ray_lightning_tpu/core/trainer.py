@@ -1,0 +1,543 @@
+"""Trainer: owns the jitted SPMD train/eval loops.
+
+The reference borrowed this entirely from PyTorch Lightning and only hosted
+it remotely (DDPSpawnPlugin.new_process invoked at reference
+ray_ddp.py:238-241). The rebuild owns the loop, TPU-first:
+
+  * ONE compiled program per step: `jax.value_and_grad` + optax update fused
+    under `jax.jit`, full TrainState donated so params/opt-state update in
+    place in HBM;
+  * sharding by annotation: the Strategy places state/batches on the mesh,
+    XLA emits the collectives (grad psum over `data`, FSDP all-gather /
+    reduce-scatter over `fsdp`) — no process group, no explicit allreduce;
+  * static shapes: dataloaders drop ragged tails so the step compiles once;
+  * gradient accumulation via `lax.scan` over a microbatch axis (no Python
+    loop inside jit);
+  * metrics come back as device scalars and are fetched lazily to avoid a
+    host sync per step.
+
+API parity (C2 of SURVEY §7.1): fit/validate/test/predict, callbacks,
+checkpointing, early stopping — everything the reference's BoringModel
+exercises (reference tests/utils.py:26-93).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Any, Dict, Iterable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_lightning_tpu.checkpoint import restore_checkpoint, save_checkpoint
+from ray_lightning_tpu.checkpoint.io import read_meta
+from ray_lightning_tpu.core.callbacks import (
+    Callback,
+    ModelCheckpoint,
+    ProgressLogger,
+)
+from ray_lightning_tpu.core.data import DataModule
+from ray_lightning_tpu.core.module import TpuModule
+from ray_lightning_tpu.core.state import TrainState
+from ray_lightning_tpu.parallel.strategy import SingleDevice, Strategy
+from ray_lightning_tpu.utils import get_logger, seed_everything
+
+log = get_logger(__name__)
+
+
+class Trainer:
+    def __init__(
+        self,
+        strategy: Optional[Strategy] = None,
+        max_epochs: int = 1,
+        max_steps: int = -1,
+        callbacks: Optional[List[Callback]] = None,
+        limit_train_batches: Optional[int] = None,
+        limit_val_batches: Optional[int] = None,
+        check_val_every_n_epoch: int = 1,
+        log_every_n_steps: int = 50,
+        accumulate_grad_batches: int = 1,
+        gradient_clip_val: Optional[float] = None,
+        precision: str = "f32",  # "f32" | "bf16" (cast float inputs)
+        seed: Optional[int] = None,
+        default_root_dir: Optional[str] = None,
+        enable_checkpointing: bool = True,
+        enable_progress_bar: bool = True,
+        profiler_dir: Optional[str] = None,
+        num_sanity_val_steps: int = 0,
+    ):
+        self.strategy = strategy or SingleDevice()
+        self.max_epochs = max_epochs
+        self.max_steps = max_steps
+        self.limit_train_batches = limit_train_batches
+        self.limit_val_batches = limit_val_batches
+        self.check_val_every_n_epoch = max(1, check_val_every_n_epoch)
+        self.log_every_n_steps = log_every_n_steps
+        self.accumulate_grad_batches = max(1, accumulate_grad_batches)
+        self.gradient_clip_val = gradient_clip_val
+        self.precision = precision
+        self.seed = seed
+        self.default_root_dir = default_root_dir or os.path.join(
+            os.getcwd(), "rlt_logs"
+        )
+        self.profiler_dir = profiler_dir
+        self.num_sanity_val_steps = num_sanity_val_steps
+
+        self.callbacks: List[Callback] = list(callbacks or [])
+        if enable_checkpointing and not any(
+            isinstance(c, ModelCheckpoint) for c in self.callbacks
+        ):
+            self.callbacks.append(ModelCheckpoint())
+        if enable_progress_bar and not any(
+            isinstance(c, ProgressLogger) for c in self.callbacks
+        ):
+            self.callbacks.append(ProgressLogger(log_every_n_steps))
+
+        # run state
+        self.state: Optional[TrainState] = None
+        self.module: Optional[TpuModule] = None
+        self.tx: Optional[optax.GradientTransformation] = None
+        self.callback_metrics: Dict[str, Any] = {}
+        self.current_epoch = 0
+        self.global_step = 0
+        self.should_stop = False
+        self.has_validation = False
+        self.last_batch_size: Optional[int] = None
+        self._train_step = None
+        self._eval_step = None
+        self._base_rng = None
+        self.is_fitted = False
+
+    # ------------------------------------------------------------------ fit
+
+    @property
+    def checkpoint_callback(self) -> Optional[ModelCheckpoint]:
+        for c in self.callbacks:
+            if isinstance(c, ModelCheckpoint):
+                return c
+        return None
+
+    def fit(
+        self,
+        module: TpuModule,
+        train_dataloaders: Optional[Iterable] = None,
+        val_dataloaders: Optional[Iterable] = None,
+        datamodule: Optional[DataModule] = None,
+        ckpt_path: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        seed = seed_everything(self.seed)
+        self._base_rng = jax.random.key(seed)
+        self.module = module
+        module.trainer = self
+        module.setup()
+
+        if datamodule is not None:
+            datamodule.setup()
+            train_dataloaders = datamodule.train_dataloader()
+            val_dataloaders = val_dataloaders or datamodule.val_dataloader()
+        if train_dataloaders is None:
+            raise ValueError("fit() needs train_dataloaders or a datamodule")
+        self.has_validation = val_dataloaders is not None
+
+        self.strategy.setup(module)
+        example_batch, train_dataloaders = self._peek(train_dataloaders)
+
+        self.tx = self._build_tx(module)
+        self.state = self._init_state(module, example_batch, ckpt_path)
+        self._train_step = self._make_train_step(module)
+        self._eval_step = self._make_eval_step(module, module.validation_step)
+
+        module.on_fit_start(self)
+        self._invoke("on_fit_start")
+        try:
+            if self.num_sanity_val_steps and self.has_validation:
+                self._run_eval_epoch(
+                    val_dataloaders, limit=self.num_sanity_val_steps, sanity=True
+                )
+            self._fit_loop(train_dataloaders, val_dataloaders)
+        except BaseException as exc:  # surface to callbacks, then re-raise
+            self._invoke("on_exception", exc)
+            raise
+        finally:
+            # Parity C5: the driver-side module object holds trained weights.
+            if self.state is not None:
+                module.params = self.state.params
+        module.on_fit_end(self)
+        self._invoke("on_fit_end")
+        self.is_fitted = True
+        return dict(self.callback_metrics)
+
+    def _fit_loop(self, train_loader, val_loader) -> None:
+        profile_ctx = self._maybe_profile()
+        with profile_ctx:
+            for epoch in range(self.current_epoch, self.max_epochs):
+                self.current_epoch = epoch
+                if hasattr(train_loader, "set_epoch"):
+                    train_loader.set_epoch(epoch)
+                self.module.on_train_epoch_start(self)
+                self._invoke("on_train_epoch_start")
+                self._run_train_epoch(train_loader)
+                run_val = (
+                    self.has_validation
+                    and (epoch + 1) % self.check_val_every_n_epoch == 0
+                )
+                if run_val:
+                    metrics = self._run_eval_epoch(
+                        val_loader, limit=self.limit_val_batches
+                    )
+                    self.callback_metrics.update(metrics)
+                    self.module.on_validation_epoch_end(self, metrics)
+                    self._invoke("on_validation_epoch_end", metrics)
+                self.module.on_train_epoch_end(self)
+                self._invoke("on_train_epoch_end")
+                if self.should_stop or self._hit_max_steps():
+                    break
+
+    def _run_train_epoch(self, loader) -> None:
+        pending: Dict[str, Any] = {}
+        for batch_idx, batch in enumerate(loader):
+            if (
+                self.limit_train_batches is not None
+                and batch_idx >= self.limit_train_batches
+            ):
+                break
+            batch = self._cast(batch)
+            self.last_batch_size = _leading_dim(batch)
+            device_batch = self._shard_train_batch(batch)
+            self.state, metrics = self._train_step(
+                self.state, device_batch, self._base_rng
+            )
+            self.global_step += 1
+            pending = metrics
+            # Lazy metric fetch: only sync on the logging cadence.
+            if self.global_step % max(1, self.log_every_n_steps) == 0:
+                host = _to_host(metrics)
+                self.callback_metrics.update(host)
+                pending = host
+            self._invoke("on_train_batch_end", pending, batch_idx)
+            if self.should_stop or self._hit_max_steps():
+                break
+        if pending:
+            self.callback_metrics.update(_to_host(pending))
+
+    def _run_eval_epoch(
+        self, loader, limit: Optional[int] = None, sanity: bool = False
+    ) -> Dict[str, float]:
+        if hasattr(loader, "set_epoch"):
+            loader.set_epoch(self.current_epoch)
+        totals: Dict[str, float] = {}
+        weights = 0.0
+        for batch_idx, batch in enumerate(loader):
+            if limit is not None and batch_idx >= limit:
+                break
+            batch = self._cast(batch)
+            bs = _leading_dim(batch) or 1
+            device_batch = self.strategy.shard_batch(batch)
+            metrics = _to_host(self._eval_step(self.state.params, device_batch))
+            for k, v in metrics.items():
+                totals[k] = totals.get(k, 0.0) + float(v) * bs
+            weights += bs
+        if sanity or weights == 0:
+            return {}
+        return {k: v / weights for k, v in totals.items()}
+
+    # ------------------------------------------------------- validate & co.
+
+    def validate(self, module: Optional[TpuModule] = None, dataloaders=None,
+                 datamodule: Optional[DataModule] = None) -> Dict[str, float]:
+        module = self._attach(module)
+        if datamodule is not None:
+            datamodule.setup()
+            dataloaders = datamodule.val_dataloader()
+        self._eval_step = self._make_eval_step(module, module.validation_step)
+        self._ensure_state(module, dataloaders)
+        metrics = self._run_eval_epoch(dataloaders, limit=self.limit_val_batches)
+        self.callback_metrics.update(metrics)
+        return metrics
+
+    def test(self, module: Optional[TpuModule] = None, dataloaders=None,
+             datamodule: Optional[DataModule] = None) -> Dict[str, float]:
+        module = self._attach(module)
+        if datamodule is not None:
+            datamodule.setup()
+            dataloaders = datamodule.test_dataloader()
+        self._eval_step = self._make_eval_step(module, module.test_step)
+        self._ensure_state(module, dataloaders)
+        metrics = self._run_eval_epoch(dataloaders, limit=self.limit_val_batches)
+        self.callback_metrics.update(metrics)
+        return metrics
+
+    def predict(self, module: Optional[TpuModule] = None, dataloaders=None,
+                datamodule: Optional[DataModule] = None) -> List[Any]:
+        module = self._attach(module)
+        if datamodule is not None:
+            datamodule.setup()
+            dataloaders = datamodule.predict_dataloader()
+        self._ensure_state(module, dataloaders)
+        step = jax.jit(lambda p, b: module.predict_step(p, b))
+        outs = []
+        for batch in dataloaders:
+            batch = self._cast(batch)
+            device_batch = self.strategy.shard_batch(batch)
+            outs.append(_to_host(step(self.state.params, device_batch)))
+        return outs
+
+    # --------------------------------------------------------- checkpoints
+
+    def save_checkpoint(self, path: str) -> str:
+        assert self.state is not None, "nothing to save; fit first"
+        ckpt_meta = {
+            "epoch": self.current_epoch,
+            "global_step": self.global_step,
+            "module_class": type(self.module).__name__,
+            "hparams": self.module.hparams,
+        }
+        checkpoint = {
+            "params": self.state.params,
+            "opt_state": self.state.opt_state,
+            "step": self.state.step,
+        }
+        self.module.on_save_checkpoint(checkpoint)
+        self._invoke("on_save_checkpoint", checkpoint)
+        return save_checkpoint(path, checkpoint, ckpt_meta)
+
+    # ------------------------------------------------------------ plumbing
+
+    def _attach(self, module: Optional[TpuModule]) -> TpuModule:
+        module = module or self.module
+        if module is None:
+            raise ValueError("no module; pass one or fit first")
+        self.module = module
+        module.trainer = self
+        module.setup()
+        if self.strategy.mesh is None:
+            self.strategy.setup(module)
+        return module
+
+    def _ensure_state(self, module: TpuModule, loader) -> None:
+        if self.state is not None:
+            return
+        if module.params is None:
+            if loader is None:
+                raise ValueError("module has no params and no data to init from")
+            batch, loader = self._peek(loader)
+            batch = self._cast(batch)
+            rng = jax.random.key(seed_everything(self.seed))
+            module.params = module.init_params(rng, batch)
+        params = self.strategy.shard_params(module.params)
+        self.state = TrainState(
+            step=jnp.zeros((), jnp.int32), params=params, opt_state=()
+        )
+        if self._eval_step is None:
+            self._eval_step = self._make_eval_step(module, module.validation_step)
+
+    def _build_tx(self, module: TpuModule) -> optax.GradientTransformation:
+        tx = module.configure_optimizers()
+        if self.gradient_clip_val:
+            tx = optax.chain(optax.clip_by_global_norm(self.gradient_clip_val), tx)
+        return tx
+
+    def _init_state(
+        self, module: TpuModule, example_batch, ckpt_path: Optional[str]
+    ) -> TrainState:
+        example_batch = self._cast(example_batch)
+        # Dedicated init stream: must not collide with fold_in(rng, step=0)
+        # used by the first training step.
+        rng = jax.random.fold_in(self._base_rng, 0x696E6974)  # "init"
+
+        if module.params is not None:
+            # Pre-loaded weights (load_from_checkpoint / warm start).
+            params = self.strategy.shard_params(module.params)
+        else:
+            # Shard-aware init: eval_shape → shardings → jit init with
+            # out_shardings, so an 8B-param model never materializes
+            # unsharded on one device.
+            init_fn = lambda r: module.init_params(r, example_batch)
+            abstract = jax.eval_shape(init_fn, rng)
+            shardings = self.strategy.param_shardings(abstract)
+            params = jax.jit(init_fn, out_shardings=shardings)(rng)
+
+        # Optimizer state: sharding propagates from params through tx.init.
+        opt_state = jax.jit(self.tx.init)(params)
+        state = TrainState(
+            step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state
+        )
+        if ckpt_path:
+            restored = restore_checkpoint(
+                ckpt_path,
+                {"params": state.params, "opt_state": state.opt_state,
+                 "step": state.step},
+            )
+            meta = read_meta(ckpt_path)
+            self.current_epoch = int(meta.get("epoch", -1)) + 1
+            self.global_step = int(meta.get("global_step", 0))
+            module.on_load_checkpoint(restored)
+            self._invoke("on_load_checkpoint", restored)
+            state = TrainState(
+                step=restored["step"],
+                params=restored["params"],
+                opt_state=restored["opt_state"],
+            )
+        return state
+
+    def _make_train_step(self, module: TpuModule):
+        tx = self.tx
+        accum = self.accumulate_grad_batches
+
+        def loss_fn(params, batch, rng):
+            out = module.training_step(params, batch, rng)
+            if isinstance(out, tuple):
+                loss, metrics = out
+            else:
+                loss, metrics = out, {}
+            metrics = {**metrics, **module.pop_logged()}
+            return loss, metrics
+
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+        def step(state: TrainState, batch, base_rng):
+            rng = jax.random.fold_in(base_rng, state.step)
+            if accum == 1:
+                (loss, metrics), grads = grad_fn(state.params, batch, rng)
+            else:
+                # batch leading axis = accum microbatches; scan-accumulate.
+                def body(carry, micro):
+                    sum_grads, i = carry
+                    (l, m), g = grad_fn(
+                        state.params, micro, jax.random.fold_in(rng, i)
+                    )
+                    sum_grads = jax.tree.map(jnp.add, sum_grads, g)
+                    return (sum_grads, i + 1), (l, m)
+
+                zero = jax.tree.map(jnp.zeros_like, state.params)
+                (grads, _), (losses, metricses) = jax.lax.scan(
+                    body, (zero, 0), batch
+                )
+                grads = jax.tree.map(lambda g: g / accum, grads)
+                loss = losses.mean()
+                metrics = jax.tree.map(lambda m: m.mean(axis=0), metricses)
+            updates, opt_state = tx.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            metrics = {
+                "loss": loss,
+                "grad_norm": optax.global_norm(grads),
+                **metrics,
+            }
+            return (
+                state.replace(
+                    step=state.step + 1, params=params, opt_state=opt_state
+                ),
+                metrics,
+            )
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    def _make_eval_step(self, module: TpuModule, step_fn):
+        def step(params, batch):
+            metrics = step_fn(params, batch)
+            logged = module.pop_logged()
+            if metrics is None:
+                metrics = {}
+            if not isinstance(metrics, dict):
+                metrics = {"val_loss": metrics}
+            return {**metrics, **logged}
+
+        return jax.jit(step)
+
+    def _shard_train_batch(self, batch):
+        accum = self.accumulate_grad_batches
+        if accum > 1:
+            def split(x):
+                x = np.asarray(x)
+                if x.shape[0] % accum != 0:
+                    raise ValueError(
+                        f"batch dim {x.shape[0]} not divisible by "
+                        f"accumulate_grad_batches={accum}"
+                    )
+                return x.reshape((accum, x.shape[0] // accum) + x.shape[1:])
+
+            batch = jax.tree.map(split, batch)
+            import jax.sharding as js
+
+            spec = self.strategy.batch_spec()
+            micro_spec = js.PartitionSpec(None, *spec)
+            sharding = js.NamedSharding(self.strategy.mesh, micro_spec)
+            return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+        return self.strategy.shard_batch(batch)
+
+    def _cast(self, batch):
+        if self.precision != "bf16":
+            return batch
+        def cast(x):
+            x = np.asarray(x)
+            if np.issubdtype(x.dtype, np.floating):
+                return x.astype(jnp.bfloat16)
+            return x
+        return jax.tree.map(cast, batch)
+
+    def _peek(self, loader):
+        """Grab batch 0 without losing it. One-shot iterators (generators)
+        are re-stitched with itertools.chain; they support one epoch only."""
+        import itertools
+
+        it = iter(loader)
+        first = next(it)
+        if it is loader:
+            if self.max_epochs > 1:
+                log.warning(
+                    "train data is a one-shot iterator; it will be exhausted "
+                    "after one epoch — pass a re-iterable (e.g. DataLoader) "
+                    "for multi-epoch training"
+                )
+            return first, itertools.chain([first], it)
+        return first, loader
+
+    def _hit_max_steps(self) -> bool:
+        return self.max_steps > 0 and self.global_step >= self.max_steps
+
+    def _invoke(self, hook: str, *args) -> None:
+        for cb in self.callbacks:
+            getattr(cb, hook)(self, self.module, *args)
+
+    def _maybe_profile(self):
+        if not self.profiler_dir:
+            return contextlib.nullcontext()
+        os.makedirs(self.profiler_dir, exist_ok=True)
+        return _ProfilerCtx(self.profiler_dir)
+
+
+class _ProfilerCtx:
+    """jax.profiler trace over the fit loop (SURVEY §5.1: absent in the
+    reference; table stakes on TPU — produces XPlane traces per host)."""
+
+    def __init__(self, logdir: str):
+        self.logdir = logdir
+
+    def __enter__(self):
+        jax.profiler.start_trace(self.logdir)
+        return self
+
+    def __exit__(self, *exc):
+        jax.profiler.stop_trace()
+        return False
+
+
+def _to_host(tree) -> Any:
+    fetched = jax.device_get(tree)
+    if isinstance(fetched, dict):
+        return {
+            k: (np.asarray(v) if hasattr(v, "shape") and np.ndim(v) else float(v))
+            for k, v in fetched.items()
+        }
+    return jax.tree.map(np.asarray, fetched)
+
+
+def _leading_dim(batch) -> Optional[int]:
+    leaves = jax.tree.leaves(batch)
+    if not leaves:
+        return None
+    shape = getattr(leaves[0], "shape", None)
+    return int(shape[0]) if shape else None

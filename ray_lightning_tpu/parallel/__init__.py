@@ -1,0 +1,21 @@
+from ray_lightning_tpu.parallel.mesh import MeshSpec, make_mesh, AXIS_ORDER
+from ray_lightning_tpu.parallel.strategy import (
+    Strategy,
+    DataParallel,
+    FSDP,
+    ShardedMesh,
+    SingleDevice,
+    RayXlaPlugin,
+)
+
+__all__ = [
+    "MeshSpec",
+    "make_mesh",
+    "AXIS_ORDER",
+    "Strategy",
+    "DataParallel",
+    "FSDP",
+    "ShardedMesh",
+    "SingleDevice",
+    "RayXlaPlugin",
+]

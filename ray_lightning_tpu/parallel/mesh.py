@@ -1,0 +1,117 @@
+"""Device-mesh construction and topology queries.
+
+This layer replaces the reference's rendezvous + rank bookkeeping
+(reference: ray_lightning/ray_ddp.py:130-141 IP-based local-rank map,
+:152-156 MASTER_ADDR/PORT dance, :257-264 torch.distributed process-group
+init). On TPU there is no process group: a `jax.sharding.Mesh` over the
+slice's devices is the communication fabric, and XLA compiles collectives
+from sharding annotations. Rank helpers become topology queries.
+
+Canonical axis names (outer→inner, DCN-slowest to ICI-fastest):
+    data    — pure data parallelism (batch axis)
+    fsdp    — parameter/optimizer-state sharding (ZeRO-style), also carries batch
+    tensor  — tensor (Megatron-style) parallelism inside a layer
+    seq     — sequence/context parallelism (ring attention)
+    expert  — expert parallelism for MoE
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_ORDER = ("data", "fsdp", "expert", "seq", "tensor")
+
+# Axes whose groups should ride ICI (fast, intra-slice): tensor/seq innermost.
+# `data` is the outermost axis so multi-slice DCN traffic only carries
+# gradient all-reduces, never per-layer tensor collectives.
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh shape. -1 on at most one axis means "all remaining"."""
+
+    data: int = 1
+    fsdp: int = 1
+    expert: int = 1
+    seq: int = 1
+    tensor: int = 1
+
+    def sizes(self) -> dict[str, int]:
+        return {ax: getattr(self, ax) for ax in AXIS_ORDER}
+
+    def resolve(self, n_devices: int) -> "MeshSpec":
+        sizes = self.sizes()
+        wild = [ax for ax, s in sizes.items() if s == -1]
+        if len(wild) > 1:
+            raise ValueError(f"At most one -1 axis allowed, got {wild}")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if wild:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            sizes[wild[0]] = n_devices // fixed
+        total = math.prod(sizes.values())
+        if total != n_devices:
+            raise ValueError(
+                f"Mesh {sizes} covers {total} devices but {n_devices} are available"
+            )
+        return MeshSpec(**sizes)
+
+    def build(self, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+        devices = list(devices if devices is not None else jax.devices())
+        spec = self.resolve(len(devices))
+        shape = tuple(spec.sizes()[ax] for ax in AXIS_ORDER)
+        arr = np.asarray(devices).reshape(shape)
+        return Mesh(arr, AXIS_ORDER)
+
+
+def make_mesh(
+    data: int = 1,
+    fsdp: int = 1,
+    expert: int = 1,
+    seq: int = 1,
+    tensor: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    return MeshSpec(data, fsdp, expert, seq, tensor).build(devices)
+
+
+# --- Topology queries (replace reference's get_local_ranks / root_device) ---
+
+
+def process_index() -> int:
+    """Global host rank (reference analog: global_rank, ray_ddp.py:266-270)."""
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def global_device_count() -> int:
+    return jax.device_count()
+
+
+def dp_axis_names(mesh: Mesh) -> tuple[str, ...]:
+    """Axes that carry the batch: every non-trivial axis except tensor/seq.
+
+    `fsdp` and `expert` groups also consume distinct batch shards (ZeRO
+    semantics: each shard-group is a data-parallel replica for activations).
+    """
+    return tuple(
+        ax for ax in ("data", "fsdp", "expert") if mesh.shape.get(ax, 1) > 1
+    ) or ("data",)
+
+
+def batch_size_divisor(mesh: Mesh) -> int:
+    return math.prod(mesh.shape.get(ax, 1) for ax in dp_axis_names(mesh))

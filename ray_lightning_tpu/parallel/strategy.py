@@ -1,0 +1,330 @@
+"""Distribution strategies: how params/optimizer-state/batches map onto a mesh.
+
+This is the rebuild of the reference's plugin layer (RayPlugin,
+ray_lightning/ray_ddp.py:42-307; HorovodRayPlugin, ray_horovod.py:29-196).
+The reference had exactly one strategy — allreduce data-parallelism — in two
+protocol flavors (torch DDP / Horovod). On TPU the "protocol" dimension
+disappears (one collective fabric: XLA over ICI) and the strategy dimension
+widens: a strategy here is a *sharding policy* over a `Mesh`; XLA emits the
+collectives. No process group object exists and no explicit allreduce is
+ever called.
+
+Strategies keep the reference's constructor-object UX
+(`Trainer(strategy=DataParallel(num_workers=8))`, mirroring
+`Trainer(plugins=[RayPlugin(num_workers=8)])`, ray_ddp.py:89-94) including
+`init_hook` (ray_ddp.py:66-67,118-119) and env-var injection
+(ray_ddp.py:21-31,158-164).
+"""
+from __future__ import annotations
+
+import math
+import os
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_lightning_tpu.parallel import mesh as mesh_lib
+from ray_lightning_tpu.utils import get_logger
+from ray_lightning_tpu.utils.pytree import _path_str
+
+log = get_logger(__name__)
+
+
+class Strategy:
+    """Base sharding strategy.
+
+    Lifecycle (driven by the Trainer, cf. reference setup/start_training/
+    post_dispatch at ray_ddp.py:113,143,201):
+        setup(module)        — build the mesh, run init_hook, inject env vars
+        shard_params(params) — place the param pytree with this policy
+        shard_batch(batch)   — place a host batch as a global device array
+        teardown()           — release mesh-related state
+    """
+
+    #: mesh axes this strategy uses; subclasses override.
+    spec: mesh_lib.MeshSpec
+
+    def __init__(
+        self,
+        num_workers: Optional[int] = None,
+        init_hook: Optional[Callable[[], None]] = None,
+        env: Optional[dict[str, str]] = None,
+        devices: Optional[Sequence[jax.Device]] = None,
+    ):
+        self.num_workers = num_workers
+        self.init_hook = init_hook
+        self.env = dict(env or {})
+        self._devices = list(devices) if devices is not None else None
+        self.mesh: Optional[Mesh] = None
+        self._module = None
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def _select_devices(self) -> list[jax.Device]:
+        devices = self._devices if self._devices is not None else jax.devices()
+        if self.num_workers is not None:
+            if self.num_workers > len(devices):
+                raise ValueError(
+                    f"num_workers={self.num_workers} exceeds available "
+                    f"devices ({len(devices)})"
+                )
+            devices = devices[: self.num_workers]
+        return list(devices)
+
+    def build_spec(self, n_devices: int) -> mesh_lib.MeshSpec:
+        raise NotImplementedError
+
+    def setup(self, module=None) -> Mesh:
+        if self.env:
+            os.environ.update(self.env)
+        if self.init_hook is not None:
+            self.init_hook()
+        devices = self._select_devices()
+        self.spec = self.build_spec(len(devices))
+        self.mesh = self.spec.build(devices)
+        self._module = module
+        log.info(
+            "strategy=%s mesh=%s over %d %s device(s)",
+            type(self).__name__,
+            dict(self.mesh.shape),
+            len(devices),
+            devices[0].platform,
+        )
+        return self.mesh
+
+    def teardown(self) -> None:
+        self.mesh = None
+        self._module = None
+
+    # ---- sharding policy -------------------------------------------------
+
+    def param_spec(self, path: str, leaf) -> P:
+        """PartitionSpec for one parameter leaf. Default: replicate."""
+        return P()
+
+    def param_shardings(self, params) -> Any:
+        assert self.mesh is not None, "call setup() first"
+        module_specs = {}
+        if self._module is not None and hasattr(self._module, "param_specs"):
+            module_specs = self._module.param_specs(params) or {}
+
+        def one(path, leaf):
+            spec = module_specs.get(path)
+            if spec is None:
+                spec = self.param_spec(path, leaf)
+            spec = self._adapt_spec(spec, getattr(leaf, "shape", ()))
+            return NamedSharding(self.mesh, spec)
+
+        return jax.tree_util.tree_map_with_path(
+            lambda kp, leaf: one(_path_str(kp), leaf), params
+        )
+
+    def _adapt_spec(self, spec: P, shape) -> P:
+        """Drop mesh axes the strategy's mesh doesn't materialize (size 1)."""
+        assert self.mesh is not None
+        out = []
+        for dim in spec:
+            if dim is None:
+                out.append(None)
+                continue
+            names = dim if isinstance(dim, tuple) else (dim,)
+            kept = tuple(n for n in names if self.mesh.shape.get(n, 1) > 1)
+            out.append(kept if len(kept) > 1 else (kept[0] if kept else None))
+        while out and out[-1] is None:
+            out.pop()
+        return P(*out)
+
+    def batch_spec(self) -> P:
+        assert self.mesh is not None
+        return P(mesh_lib.dp_axis_names(self.mesh))
+
+    def batch_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, self.batch_spec())
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    # ---- placement -------------------------------------------------------
+
+    def shard_params(self, params) -> Any:
+        return jax.device_put(params, self.param_shardings(params))
+
+    def shard_batch(self, batch) -> Any:
+        """Place a host batch (pytree of numpy arrays) as global jax.Arrays.
+
+        Single-process: a plain device_put against the batch sharding.
+        Multi-process: each host holds its local shard of the global batch
+        (the DistributedSampler analog; reference forces a sampler with
+        num_replicas=num_workers, rank=global_rank at ray_ddp.py:293-303)
+        and we assemble a global array from per-process shards.
+        """
+        sharding = self.batch_sharding()
+        divisor = mesh_lib.batch_size_divisor(self.mesh)
+
+        def place(x):
+            x = np.asarray(x)
+            if x.shape and x.shape[0] % divisor != 0:
+                raise ValueError(
+                    f"Global batch dim {x.shape[0]} not divisible by "
+                    f"data-parallel degree {divisor} (mesh {dict(self.mesh.shape)})"
+                )
+            if jax.process_count() > 1:
+                return jax.make_array_from_process_local_data(sharding, x)
+            return jax.device_put(x, sharding)
+
+        return jax.tree.map(place, batch)
+
+    # ---- introspection ---------------------------------------------------
+
+    @property
+    def world_size(self) -> int:
+        return math.prod(self.mesh.shape.values()) if self.mesh else 1
+
+    @property
+    def dp_size(self) -> int:
+        return mesh_lib.batch_size_divisor(self.mesh) if self.mesh else 1
+
+
+class DataParallel(Strategy):
+    """Pure data parallelism: params replicated, batch sharded on `data`.
+
+    Parity target: `RayPlugin` (reference ray_ddp.py:42-307). The gradient
+    all-reduce the reference got from NCCL/Gloo buckets is compiled by XLA
+    from the sharding annotations (psum over the `data` axis) and rides ICI.
+    """
+
+    def build_spec(self, n_devices: int) -> mesh_lib.MeshSpec:
+        return mesh_lib.MeshSpec(data=n_devices)
+
+
+class FSDP(Strategy):
+    """ZeRO-style fully-sharded data parallelism as sharding annotations.
+
+    Params and optimizer state are sharded along the `fsdp` mesh axis (each
+    leaf on its largest divisible dimension); activations stay data-parallel.
+    XLA inserts the all-gather (forward/backward) and reduce-scatter (grad)
+    that FSDP implementations hand-schedule. Stands in the "second protocol"
+    slot Horovod occupied in the reference (ray_horovod.py:29-196) and is
+    the BASELINE.json Llama-8B strategy.
+    """
+
+    def __init__(self, *args, min_shard_size: int = 2**10, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.min_shard_size = min_shard_size
+
+    def build_spec(self, n_devices: int) -> mesh_lib.MeshSpec:
+        return mesh_lib.MeshSpec(fsdp=n_devices)
+
+    def param_spec(self, path: str, leaf) -> P:
+        return fsdp_auto_spec(
+            getattr(leaf, "shape", ()),
+            self.mesh.shape.get("fsdp", 1),
+            self.min_shard_size,
+        )
+
+    def _adapt_spec(self, spec: P, shape) -> P:
+        spec = super()._adapt_spec(spec, shape)
+        # Module-provided tensor specs still get FSDP'd on a free axis.
+        if self.mesh.shape.get("fsdp", 1) > 1 and "fsdp" not in _spec_names(spec):
+            spec = _augment_with_axis(
+                spec, shape, "fsdp", self.mesh.shape["fsdp"], self.min_shard_size
+            )
+        return spec
+
+
+class ShardedMesh(Strategy):
+    """Explicit N-D mesh strategy composing dp × fsdp × tensor × seq (× expert).
+
+    The general form: `ShardedMesh(data=2, fsdp=2, tensor=2)`. Tensor-axis
+    placement comes from the module's `param_specs` hook (Megatron-style
+    column/row splits are module knowledge); fsdp placement is automatic.
+    """
+
+    def __init__(
+        self,
+        data: int = 1,
+        fsdp: int = 1,
+        expert: int = 1,
+        seq: int = 1,
+        tensor: int = 1,
+        min_shard_size: int = 2**10,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        self._spec = mesh_lib.MeshSpec(data, fsdp, expert, seq, tensor)
+        self.min_shard_size = min_shard_size
+
+    def build_spec(self, n_devices: int) -> mesh_lib.MeshSpec:
+        return self._spec.resolve(n_devices)
+
+    def param_spec(self, path: str, leaf) -> P:
+        return fsdp_auto_spec(
+            getattr(leaf, "shape", ()),
+            self.mesh.shape.get("fsdp", 1),
+            self.min_shard_size,
+        )
+
+    _adapt_spec = FSDP._adapt_spec
+
+
+class SingleDevice(Strategy):
+    """Trivial strategy: one device, no sharding (debug / laptop path)."""
+
+    def __init__(self, **kwargs):
+        kwargs.setdefault("num_workers", 1)
+        super().__init__(**kwargs)
+
+    def build_spec(self, n_devices: int) -> mesh_lib.MeshSpec:
+        return mesh_lib.MeshSpec()
+
+
+# Reference-familiar aliases: `RayPlugin` → the TPU DP strategy; the north
+# star names it RayXlaPlugin (BASELINE.json). `use_gpu`/`num_cpus_per_worker`
+# are accepted-and-ignored for drop-in ergonomics.
+class RayXlaPlugin(DataParallel):
+    def __init__(self, num_workers: Optional[int] = None, num_cpus_per_worker: int = 1,
+                 use_gpu: bool = False, init_hook=None, **kwargs):
+        del num_cpus_per_worker, use_gpu
+        super().__init__(num_workers=num_workers, init_hook=init_hook, **kwargs)
+
+
+# ---- spec helpers --------------------------------------------------------
+
+
+def _spec_names(spec: P) -> set:
+    names = set()
+    for dim in spec:
+        if dim is None:
+            continue
+        for n in dim if isinstance(dim, tuple) else (dim,):
+            names.add(n)
+    return names
+
+
+def _augment_with_axis(
+    spec: P, shape, axis_name: str, axis_size: int, min_size: int
+) -> P:
+    """Add `axis_name` to the largest free, divisible dim of `spec`."""
+    if not shape or int(np.prod(shape)) < min_size:
+        return spec
+    dims = list(spec) + [None] * (len(shape) - len(spec))
+    candidates = sorted(
+        range(len(shape)), key=lambda i: shape[i], reverse=True
+    )
+    for i in candidates:
+        if dims[i] is None and shape[i] % axis_size == 0:
+            dims[i] = axis_name
+            return P(*dims)
+    return spec
+
+
+def fsdp_auto_spec(shape, fsdp_size: int, min_size: int) -> P:
+    """Shard the largest divisible dim on `fsdp`; replicate small leaves."""
+    if fsdp_size <= 1:
+        return P()
+    return _augment_with_axis(P(*([None] * len(shape))), shape, "fsdp",
+                              fsdp_size, min_size)
+
+

@@ -1,0 +1,19 @@
+from ray_lightning_tpu.utils.pytree import (
+    tree_size_bytes,
+    tree_param_count,
+    named_leaves,
+    host_copy,
+)
+from ray_lightning_tpu.utils.seeding import seed_everything
+from ray_lightning_tpu.utils.logging import get_logger
+from ray_lightning_tpu.utils.devices import simulate_cpu_devices
+
+__all__ = [
+    "tree_size_bytes",
+    "tree_param_count",
+    "named_leaves",
+    "host_copy",
+    "seed_everything",
+    "get_logger",
+    "simulate_cpu_devices",
+]

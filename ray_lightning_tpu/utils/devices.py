@@ -1,0 +1,21 @@
+"""Device-emulation helper: the TPU analog of the reference's throwaway
+local Ray cluster (`ray.init(num_cpus=2)`, reference tests/test_ddp.py:16-21).
+
+Call before any other JAX use (works even if jax is already imported, as
+long as no backend has initialized yet)."""
+from __future__ import annotations
+
+import os
+
+
+def simulate_cpu_devices(n: int = 8) -> None:
+    """Emulate an n-device mesh on host CPU for tests/laptops/CI."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

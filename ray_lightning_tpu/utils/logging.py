@@ -1,0 +1,22 @@
+"""Framework logger (reference uses PTL's logger; ray_lightning/ray_ddp.py:9)."""
+from __future__ import annotations
+
+import logging
+import sys
+
+_CONFIGURED = False
+
+
+def get_logger(name: str = "ray_lightning_tpu") -> logging.Logger:
+    global _CONFIGURED
+    if not _CONFIGURED:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(
+            logging.Formatter("[%(asctime)s %(name)s %(levelname)s] %(message)s")
+        )
+        root = logging.getLogger("ray_lightning_tpu")
+        root.addHandler(handler)
+        root.setLevel(logging.INFO)
+        root.propagate = False
+        _CONFIGURED = True
+    return logging.getLogger(name)

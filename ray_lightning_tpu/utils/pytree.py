@@ -1,0 +1,48 @@
+"""Pytree helpers shared across the framework."""
+from __future__ import annotations
+
+from typing import Any, Iterator
+
+import jax
+import numpy as np
+
+
+def tree_param_count(tree: Any) -> int:
+    """Total number of array elements in a pytree."""
+    return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(tree))
+
+
+def tree_size_bytes(tree: Any) -> int:
+    """Total bytes of a pytree of arrays."""
+    return sum(
+        int(np.prod(x.shape)) * x.dtype.itemsize for x in jax.tree.leaves(tree)
+    )
+
+
+def named_leaves(tree: Any) -> Iterator[tuple[str, Any]]:
+    """Yield ("path/to/leaf", leaf) pairs with slash-joined string keys."""
+    for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
+        yield _path_str(path), leaf
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def host_copy(tree: Any) -> Any:
+    """Fetch a (possibly sharded) pytree of device arrays to host numpy.
+
+    Sharded arrays are gathered; this is the small-model convenience path —
+    large models should go through the sharded checkpoint writer instead.
+    """
+    return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)

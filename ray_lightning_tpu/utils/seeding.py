@@ -1,0 +1,28 @@
+"""Deterministic seeding.
+
+Parity: the reference propagates ``PL_GLOBAL_SEED`` to every worker
+(reference: ray_lightning/ray_ddp.py:158-164). Here a single seed drives
+numpy, python random, and the JAX PRNG key threaded through the Trainer.
+"""
+from __future__ import annotations
+
+import os
+import random
+from typing import Optional
+
+import numpy as np
+
+GLOBAL_SEED_ENV = "RLT_GLOBAL_SEED"
+
+
+def seed_everything(seed: Optional[int] = None) -> int:
+    """Seed python/numpy and export the seed for worker processes.
+
+    Returns the seed actually used (drawn from the env var or 0 if unset).
+    """
+    if seed is None:
+        seed = int(os.environ.get(GLOBAL_SEED_ENV, 0))
+    os.environ[GLOBAL_SEED_ENV] = str(seed)
+    random.seed(seed)
+    np.random.seed(seed % (2**32))
+    return seed

@@ -1,0 +1,37 @@
+"""Test harness: a virtual 8-device CPU mesh, no TPU required.
+
+This is the analog of the reference's throwaway local Ray clusters
+(`ray.init(num_cpus=2)` fixtures, reference tests/test_ddp.py:16-21):
+`--xla_force_host_platform_device_count=8` gives true multi-device SPMD
+semantics (real shardings, real collectives compiled by XLA's CPU backend)
+on any box.
+"""
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_seed_env(monkeypatch, tmp_path):
+    monkeypatch.delenv("RLT_GLOBAL_SEED", raising=False)
+    monkeypatch.chdir(tmp_path)
+    yield
+
+
+@pytest.fixture
+def devices8():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,102 @@
+"""Data-parallel strategy over the virtual 8-device mesh.
+
+Coverage modeled on reference tests/test_ddp.py: train/load/predict matrix
+over worker counts (:79-113), sharding wiring (:44-76), and the
+num_workers=actor-count invariant (:29-41) recast as mesh-shape asserts.
+"""
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from ray_lightning_tpu import DataLoader, DataParallel, RayXlaPlugin, Trainer
+from tests.utils import (
+    BoringModel,
+    MNISTClassifier,
+    get_trainer,
+    load_test,
+    predict_test,
+    synthetic_mnist,
+    random_dataset,
+)
+
+
+@pytest.mark.parametrize("num_workers", [2, 8])
+def test_mesh_matches_num_workers(tmp_path, num_workers):
+    strategy = DataParallel(num_workers=num_workers)
+    trainer = get_trainer(tmp_path, strategy, max_epochs=1)
+    trainer.fit(BoringModel(), DataLoader(random_dataset(), batch_size=32))
+    assert strategy.mesh is not None
+    assert strategy.mesh.shape["data"] == num_workers
+    assert strategy.dp_size == num_workers
+
+
+def test_batch_is_sharded_params_replicated(tmp_path):
+    strategy = DataParallel(num_workers=8)
+    trainer = get_trainer(tmp_path, strategy, max_epochs=1,
+                          limit_train_batches=2)
+    module = BoringModel()
+    trainer.fit(module, DataLoader(random_dataset(), batch_size=32))
+    # params replicated across the mesh
+    for leaf in jax.tree.leaves(trainer.state.params):
+        assert leaf.sharding.is_fully_replicated
+    # batch sharding: leading dim over 'data'
+    batch = strategy.shard_batch({"x": np.zeros((32, 4), np.float32)})
+    assert batch["x"].sharding.spec == P(("data",))
+
+
+@pytest.mark.parametrize("num_workers", [1, 2])
+def test_train_load_predict(tmp_path, num_workers):
+    """The reference's canonical matrix (test_ddp.py:79-113)."""
+    data = synthetic_mnist()
+    module = MNISTClassifier(lr=1e-2)
+    trainer = get_trainer(
+        tmp_path, DataParallel(num_workers=num_workers), max_epochs=3,
+        limit_train_batches=None, seed=0,
+    )
+    train = DataLoader(data, batch_size=64, shuffle=True)
+    val = DataLoader(data, batch_size=64)
+    trainer.fit(module, train, val)
+    loaded = load_test(trainer, MNISTClassifier)
+    acc = predict_test(trainer, module, data)
+    assert acc >= 0.5
+    # loaded params match trained params
+    for a, b in zip(jax.tree.leaves(jax.device_get(module.params)),
+                    jax.tree.leaves(loaded.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_dp_matches_single_device(tmp_path):
+    """DP over 8 devices computes the same update as 1 device (allreduce
+    correctness — replaces the reference's trust in NCCL)."""
+
+    def run(strategy, tag):
+        module = BoringModel(lr=0.1)
+        trainer = get_trainer(tmp_path / tag, strategy, max_epochs=1,
+                              limit_train_batches=4, seed=0,
+                              checkpoint_callback=False)
+        trainer.fit(module, DataLoader(random_dataset(), batch_size=64))
+        return jax.device_get(module.params)
+
+    from ray_lightning_tpu import SingleDevice
+
+    p1 = run(SingleDevice(), "one")
+    p8 = run(DataParallel(num_workers=8), "eight")
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p8)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_ray_xla_plugin_alias(tmp_path):
+    """RayXlaPlugin(num_workers=..., use_gpu=False) is a drop-in ctor
+    (reference RayPlugin signature, ray_ddp.py:89-94)."""
+    called = {}
+
+    def init_hook():
+        called["hook"] = True
+
+    strategy = RayXlaPlugin(num_workers=2, num_cpus_per_worker=1,
+                            use_gpu=False, init_hook=init_hook)
+    trainer = get_trainer(tmp_path, strategy, max_epochs=1)
+    trainer.fit(BoringModel(), DataLoader(random_dataset(), batch_size=32))
+    assert called.get("hook"), "init_hook did not run (ray_ddp.py:118-119)"
+    assert strategy.mesh.shape["data"] == 2

@@ -1,0 +1,117 @@
+"""Core Trainer behavior on a single device (the loop the reference
+delegated to PTL; coverage modeled on reference tests/test_ddp.py)."""
+import jax
+import numpy as np
+import pytest
+
+from ray_lightning_tpu import (
+    DataLoader,
+    EarlyStopping,
+    SingleDevice,
+    Trainer,
+)
+from tests.utils import BoringModel, get_trainer, random_dataset
+
+
+def test_fit_changes_weights(tmp_path):
+    module = BoringModel()
+    trainer = get_trainer(tmp_path, SingleDevice(), max_epochs=2)
+    data = random_dataset()
+    trainer.fit(module, DataLoader(data, batch_size=32, shuffle=True),
+                DataLoader(data, batch_size=32))
+    assert module.params is not None
+    assert trainer.global_step > 0
+    assert "loss" in trainer.callback_metrics
+    assert "train_loss" in trainer.callback_metrics  # self.log inside jit
+    assert "val_loss" in trainer.callback_metrics
+
+
+def test_hooks_fire_in_order(tmp_path):
+    module = BoringModel()
+    trainer = get_trainer(tmp_path, SingleDevice(), max_epochs=1)
+    data = random_dataset()
+    trainer.fit(module, DataLoader(data, batch_size=32),
+                DataLoader(data, batch_size=32))
+    calls = module.hook_calls
+    assert calls[0] == "on_fit_start"
+    assert calls[-1] == "on_fit_end"
+    for h in ("on_train_epoch_start", "on_train_epoch_end",
+              "on_validation_epoch_end", "on_save_checkpoint"):
+        assert h in calls, f"{h} never fired"
+
+
+def test_max_steps(tmp_path):
+    module = BoringModel()
+    trainer = get_trainer(tmp_path, SingleDevice(), max_epochs=10,
+                          limit_train_batches=None, max_steps=7)
+    trainer.fit(module, DataLoader(random_dataset(), batch_size=32))
+    assert trainer.global_step == 7
+
+
+def test_grad_accumulation_matches_big_batch(tmp_path):
+    """accum=4 over micro-batches == one batch of 4x size (SGD linearity)."""
+    data = random_dataset(n=128)
+
+    def run(accum, bs):
+        module = BoringModel(lr=0.1)
+        trainer = get_trainer(
+            tmp_path / f"a{accum}", SingleDevice(), max_epochs=1,
+            limit_train_batches=2, accumulate_grad_batches=accum,
+            checkpoint_callback=False, seed=0,
+        )
+        trainer.fit(module, DataLoader(data, batch_size=bs))
+        return jax.device_get(module.params)
+
+    p1 = run(1, 128)
+    p4 = run(4, 128)
+    flat1, flat4 = jax.tree.leaves(p1), jax.tree.leaves(p4)
+    for a, b in zip(flat1, flat4):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_early_stopping(tmp_path):
+    """EarlyStopping halts the run (reference tests/test_ddp.py:116-132)."""
+    module = BoringModel(lr=0.0)  # loss can never improve
+    es = EarlyStopping(monitor="val_loss", patience=1, min_delta=1e9)
+    trainer = get_trainer(tmp_path, SingleDevice(), max_epochs=50,
+                          callbacks=[es])
+    data = random_dataset()
+    trainer.fit(module, DataLoader(data, batch_size=32),
+                DataLoader(data, batch_size=32))
+    assert trainer.should_stop
+    assert trainer.current_epoch < 49
+
+
+def test_seed_determinism(tmp_path):
+    def run():
+        module = BoringModel()
+        trainer = get_trainer(tmp_path / "d", SingleDevice(), max_epochs=1,
+                              checkpoint_callback=False, seed=123)
+        trainer.fit(module, DataLoader(random_dataset(), batch_size=32,
+                                       shuffle=True, seed=1))
+        return jax.device_get(module.params)
+
+    a, b = run(), run()
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_validate_and_test_apis(tmp_path):
+    module = BoringModel()
+    trainer = get_trainer(tmp_path, SingleDevice(), max_epochs=1)
+    data = random_dataset()
+    trainer.fit(module, DataLoader(data, batch_size=32))
+    metrics = trainer.validate(module, DataLoader(data, batch_size=32))
+    assert "val_loss" in metrics and "val_acc" in metrics
+    tmetrics = trainer.test(module, DataLoader(data, batch_size=32))
+    assert "val_loss" in tmetrics  # test_step defaults to validation_step
+
+
+def test_bad_batch_divisibility_raises(tmp_path):
+    from ray_lightning_tpu import DataParallel
+
+    module = BoringModel()
+    trainer = get_trainer(tmp_path, DataParallel(num_workers=8), max_epochs=1)
+    loader = DataLoader(random_dataset(n=60), batch_size=30, drop_last=True)
+    with pytest.raises(ValueError, match="not divisible"):
+        trainer.fit(module, loader)
