@@ -1,0 +1,105 @@
+"""Headline benchmark: Llama training-step throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no performance numbers (BASELINE.json
+"published": {} — see BASELINE.md), so the baseline here is the same
+training step with the framework's hand-tuned paths disabled (XLA-naive
+attention instead of the pallas flash kernel): vs_baseline > 1 means the
+TPU-native design beats the straightforward XLA translation of the
+reference capability.
+"""
+from __future__ import annotations
+
+import json
+import time
+from functools import partial
+
+import numpy as np
+
+
+def _make_step(use_flash: bool):
+    import jax
+    import optax
+
+    from ray_lightning_tpu.models.llama import (
+        LlamaConfig,
+        cross_entropy_loss,
+        Llama,
+    )
+
+    cfg = LlamaConfig(
+        vocab_size=32768,
+        dim=2048,
+        n_layers=8,
+        n_heads=16,
+        n_kv_heads=8,
+        hidden_dim=5632,
+        max_seq_len=2048,
+        use_flash=use_flash,
+    )
+    model = Llama(cfg)
+    batch, seq = 4, 2048
+    tokens = jax.random.randint(
+        jax.random.key(0), (batch, seq + 1), 0, cfg.vocab_size, dtype=np.int32
+    )
+    params = jax.jit(model.init)(jax.random.key(0), tokens[:, :-1])["params"]
+    tx = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
+    opt_state = jax.jit(tx.init)(params)
+
+    def loss_fn(params, tokens):
+        logits = model.apply({"params": params}, tokens[:, :-1])
+        return cross_entropy_loss(logits, tokens[:, 1:])
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step, params, opt_state, tokens, batch * seq
+
+
+def _time_step(step, params, opt_state, tokens, warmup=3, iters=10):
+    import jax
+
+    for _ in range(warmup):
+        params, opt_state, loss = step(params, opt_state, tokens)
+    # device_get, not block_until_ready: the latter can be a no-op through
+    # remote-device tunnels; fetching the loss value forces execution of
+    # the whole dependency chain.
+    float(jax.device_get(loss))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = step(params, opt_state, tokens)
+    float(jax.device_get(loss))
+    return (time.perf_counter() - t0) / iters
+
+
+def main() -> None:
+    step, params, opt_state, tokens, tokens_per_step = _make_step(
+        use_flash=True
+    )
+    dt = _time_step(step, params, opt_state, tokens)
+    tokens_per_sec = tokens_per_step / dt
+
+    del step, params, opt_state
+    step_b, params_b, opt_b, tokens_b, _ = _make_step(use_flash=False)
+    dt_base = _time_step(step_b, params_b, opt_b, tokens_b)
+    baseline_tps = tokens_per_step / dt_base
+
+    print(
+        json.dumps(
+            {
+                "metric": "llama_0.5b_train_tokens_per_sec_per_chip",
+                "value": round(tokens_per_sec, 1),
+                "unit": "tokens/sec",
+                "vs_baseline": round(tokens_per_sec / baseline_tps, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -328,9 +328,10 @@ class Trainer:
             rng = jax.random.key(seed_everything(self.seed))
             module.params = module.init_params(rng, batch)
         params = self.strategy.shard_params(module.params)
-        self.state = TrainState(
-            step=jnp.zeros((), jnp.int32), params=params, opt_state=()
+        step0 = jax.device_put(
+            jnp.zeros((), jnp.int32), self.strategy.replicated()
         )
+        self.state = TrainState(step=step0, params=params, opt_state=())
         if self._eval_step is None:
             self._eval_step = self._make_eval_step(module, module.validation_step)
 
@@ -360,11 +361,19 @@ class Trainer:
             shardings = self.strategy.param_shardings(abstract)
             params = jax.jit(init_fn, out_shardings=shardings)(rng)
 
-        # Optimizer state: sharding propagates from params through tx.init.
-        opt_state = jax.jit(self.tx.init)(params)
-        state = TrainState(
-            step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state
+        # Optimizer state: explicitly sharded (mu/nu follow their params —
+        # ZeRO semantics; scalars replicate). jit alone does NOT propagate
+        # sharding here: tx.init is shape-only, so XLA drops the input
+        # dependency and would leave the state on one device.
+        abstract_opt = jax.eval_shape(self.tx.init, params)
+        opt_shardings = self.strategy.opt_state_shardings(abstract_opt, params)
+        opt_state = jax.jit(self.tx.init, out_shardings=opt_shardings)(params)
+        # step is committed to the mesh (replicated) so the whole TrainState
+        # lives on one device set — restored checkpoints keep that layout.
+        step0 = jax.device_put(
+            jnp.zeros((), jnp.int32), self.strategy.replicated()
         )
+        state = TrainState(step=step0, params=params, opt_state=opt_state)
         if ckpt_path:
             restored = restore_checkpoint(
                 ckpt_path,

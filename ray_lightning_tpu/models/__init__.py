@@ -1,0 +1,22 @@
+"""Model families shipped with the framework.
+
+The reference ships only test MLPs and an MNIST example
+(reference tests/utils.py:96-145, examples/ray_ddp_example.py); the
+BASELINE.json configs additionally require ResNet/CIFAR, BERT fine-tune,
+and Llama-3-8B FSDP — all provided here as TpuModules.
+"""
+from ray_lightning_tpu.models.llama import (
+    Llama,
+    LlamaConfig,
+    LlamaModule,
+)
+from ray_lightning_tpu.models.mlp import MLP, MLPClassifier, MNISTClassifier
+
+__all__ = [
+    "Llama",
+    "LlamaConfig",
+    "LlamaModule",
+    "MLP",
+    "MLPClassifier",
+    "MNISTClassifier",
+]

@@ -1,0 +1,77 @@
+"""MLP classifiers — parity with the reference's example/test models.
+
+MNISTClassifier mirrors the reference's LightningMNISTClassifier
+(reference tests/utils.py:96-145: 3-layer MLP 128→256→classes, Adam) and
+the MNIST example model (reference examples/ray_ddp_example.py).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import optax
+
+from ray_lightning_tpu.core.module import TpuModule
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (128, 256)
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        for f in self.features:
+            x = nn.relu(nn.Dense(f)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+class MLPClassifier(TpuModule):
+    """Generic MLP classifier on {"x": [B, ...], "y": [B]} batches."""
+
+    def __init__(self, features: Sequence[int] = (128, 256),
+                 num_classes: int = 10, lr: float = 1e-3):
+        super().__init__()
+        self.save_hyperparameters(features=tuple(features),
+                                  num_classes=num_classes, lr=lr)
+        self.features = tuple(features)
+        self.num_classes = num_classes
+        self.lr = lr
+
+    def configure_model(self):
+        return MLP(self.features, self.num_classes)
+
+    def configure_optimizers(self):
+        return optax.adam(self.lr)
+
+    def training_step(self, params, batch, rng):
+        logits = self.apply(params, batch["x"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]
+        ).mean()
+        acc = (logits.argmax(-1) == batch["y"]).mean()
+        self.log("ptl/train_loss", loss)
+        self.log("ptl/train_accuracy", acc)
+        return loss
+
+    def validation_step(self, params, batch):
+        logits = self.apply(params, batch["x"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]
+        ).mean()
+        acc = (logits.argmax(-1) == batch["y"]).mean()
+        return {"ptl/val_loss": loss, "ptl/val_accuracy": acc}
+
+    def predict_step(self, params, batch):
+        return self.apply(params, batch["x"]).argmax(-1)
+
+
+class MNISTClassifier(MLPClassifier):
+    """Reference examples/ray_ddp_example.py MNISTClassifier analog."""
+
+    def __init__(self, lr: float = 1e-3, layer_1: int = 128,
+                 layer_2: int = 256):
+        super().__init__(features=(layer_1, layer_2), num_classes=10, lr=lr)
+        self.hparams.clear()  # ctor signature differs from parent's
+        self.save_hyperparameters(lr=lr, layer_1=layer_1, layer_2=layer_2)

@@ -1,0 +1,24 @@
+"""TPU compute ops: attention, norms, rotary embeddings, pallas kernels.
+
+The reference has no compute ops of its own (its models are MLPs and the
+hot loop belongs to torch/NCCL — reference tests/utils.py:96-120). Here the
+framework owns the compute path, so the hot ops are first-class: jax
+reference implementations that XLA fuses well, with pallas TPU kernels for
+the ones worth hand-tiling (flash attention, fused rmsnorm).
+"""
+from ray_lightning_tpu.ops.attention import (
+    dot_product_attention,
+    flash_attention,
+    make_causal_mask,
+)
+from ray_lightning_tpu.ops.norms import rms_norm
+from ray_lightning_tpu.ops.rope import apply_rope, rope_frequencies
+
+__all__ = [
+    "dot_product_attention",
+    "flash_attention",
+    "make_causal_mask",
+    "rms_norm",
+    "apply_rope",
+    "rope_frequencies",
+]

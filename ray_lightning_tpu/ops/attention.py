@@ -1,0 +1,104 @@
+"""Attention ops: masked SDPA reference + flash-attention dispatch.
+
+The hot op of the flagship model. Three tiers:
+  1. `dot_product_attention` — pure jnp reference (materializes the S×S
+     score matrix); correct everywhere, used for tests and tiny shapes.
+  2. `flash_attention` — tiled online-softmax kernel
+     (ray_lightning_tpu.ops.pallas.flash) that never materializes scores;
+     O(S) memory, MXU-shaped tiles. Falls back to (1) off-TPU or for
+     shapes that don't tile.
+All take [B, S, H, D] (batch, seq, heads, head_dim) and support GQA by
+repeating KV heads (XLA turns the repeat into a broadcast, no HBM copy).
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def make_causal_mask(q_len: int, kv_len: int, q_offset: int = 0) -> jnp.ndarray:
+    """Boolean [q_len, kv_len] mask, True = attend. q_offset shifts the
+    query positions (used by sequence-parallel shards / decoding)."""
+    q_pos = jnp.arange(q_len)[:, None] + q_offset
+    kv_pos = jnp.arange(kv_len)[None, :]
+    return q_pos >= kv_pos
+
+
+def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """[B, S, H_kv, D] -> [B, S, H_kv*n_rep, D] for GQA."""
+    if n_rep == 1:
+        return x
+    b, s, h, d = x.shape
+    x = jnp.broadcast_to(x[:, :, :, None, :], (b, s, h, n_rep, d))
+    return x.reshape(b, s, h * n_rep, d)
+
+
+def dot_product_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    mask: jnp.ndarray | None = None,
+    q_offset: int = 0,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Reference SDPA: [B, S, H, D] in, [B, S, H, D] out; f32 softmax."""
+    if k.shape[2] != q.shape[2]:
+        n_rep = q.shape[2] // k.shape[2]
+        k = repeat_kv(k, n_rep)
+        v = repeat_kv(v, n_rep)
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    # [B, H, S, S]
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        cm = make_causal_mask(q.shape[1], k.shape[1], q_offset)
+        scores = jnp.where(cm[None, None], scores, -jnp.inf)
+    if mask is not None:
+        # mask: [B, S_kv] padding mask or [B, 1, S_q, S_kv]
+        if mask.ndim == 2:
+            mask = mask[:, None, None, :]
+        scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    mask: jnp.ndarray | None = None,
+    q_offset: int = 0,
+    use_pallas: bool | None = None,
+) -> jnp.ndarray:
+    """Tiled attention. Dispatches to the pallas TPU kernel when on TPU
+    (or forced via RLT_PALLAS=1 with interpret mode on CPU) and the shape
+    tiles cleanly; otherwise the XLA reference path (which XLA still fuses
+    reasonably — flash matters at long S where the S×S scores don't fit)."""
+    if use_pallas is None:
+        env = os.environ.get("RLT_PALLAS")
+        use_pallas = _on_tpu() if env is None else env == "1"
+    if use_pallas and mask is None:
+        from ray_lightning_tpu.ops.pallas.flash import (
+            flash_attention_pallas,
+            shapes_supported,
+        )
+
+        if shapes_supported(q.shape, k.shape):
+            return flash_attention_pallas(q, k, v, causal=causal,
+                                          q_offset=q_offset)
+    return dot_product_attention(q, k, v, causal=causal, mask=mask,
+                                 q_offset=q_offset)

@@ -1,0 +1,31 @@
+"""Normalization ops: RMSNorm reference implementation.
+
+`rms_norm` here is the jnp reference; `ray_lightning_tpu.ops.pallas.rmsnorm`
+provides the fused TPU kernel and `rms_norm(..., use_pallas=True)` (or the
+RLT_PALLAS=1 env var) selects it. The reduction is done in float32 even for
+bf16 activations — matches Llama reference numerics.
+"""
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+
+
+def rms_norm(
+    x: jnp.ndarray,
+    weight: jnp.ndarray,
+    eps: float = 1e-5,
+    use_pallas: bool | None = None,
+) -> jnp.ndarray:
+    """y = x / rms(x) * weight, reducing over the last axis in f32."""
+    if use_pallas is None:
+        use_pallas = os.environ.get("RLT_PALLAS", "0") == "1"
+    if use_pallas:
+        from ray_lightning_tpu.ops.pallas.rmsnorm import rms_norm_pallas
+
+        return rms_norm_pallas(x, weight, eps=eps)
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    y = xf * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (y * weight.astype(jnp.float32)).astype(x.dtype)

@@ -1,0 +1,9 @@
+"""Pallas TPU kernels for the hot ops.
+
+Kernels run in interpret mode automatically off-TPU (CPU tests), so the
+same code path is exercised by the virtual-device test harness.
+"""
+from ray_lightning_tpu.ops.pallas.flash import flash_attention_pallas
+from ray_lightning_tpu.ops.pallas.rmsnorm import rms_norm_pallas
+
+__all__ = ["flash_attention_pallas", "rms_norm_pallas"]

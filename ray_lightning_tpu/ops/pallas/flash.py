@@ -1,0 +1,368 @@
+"""Flash attention (forward + backward) as pallas TPU kernels.
+
+Online-softmax tiling (Flash-Attention-2 schedule): the S×S score matrix is
+never materialized in HBM; each grid step streams one KV tile through VMEM
+against a resident Q tile, keeping running (max, sum, acc) statistics in
+f32 scratch. Causal blocks that are fully masked are skipped (predicated
+body). Backward recomputes P from the saved logsumexp, in two passes:
+one gridded over KV tiles (dK, dV) and one over Q tiles (dQ) — no atomics,
+which TPUs don't have.
+
+Layout: kernels work on [B, H, S, D]; the public wrapper takes the
+framework-standard [B, S, H, D] and transposes (XLA folds the transpose
+into neighboring ops). GQA is handled by an index_map trick: KV tiles are
+indexed with h // n_rep, so KV heads are read in place — no repeat, no
+extra HBM traffic.
+
+Tiling constraints: S must divide by the block size (default 256, clamped
+to S) and D should be a multiple of 128 (MXU lane width) — callers check
+`shapes_supported` and fall back to the XLA path otherwise.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+_NEG_INF = -1e30  # avoid true -inf: exp(-inf - -inf) = nan on fully-masked rows
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def shapes_supported(q_shape, k_shape) -> bool:
+    """[B, S, H, D]: blocks must tile S; D must be lane-aligned."""
+    b, sq, hq, d = q_shape
+    _, sk, hk, _ = k_shape
+    if d % 128 != 0 and d not in (64,):  # 64 still tiles acceptably
+        return False
+    if hq % hk != 0:
+        return False
+    if sq % 8 != 0 or sk % 8 != 0:  # sublane alignment
+        return False
+    bq = min(DEFAULT_BLOCK_Q, sq)
+    bk = min(DEFAULT_BLOCK_K, sk)
+    return sq % bq == 0 and sk % bk == 0
+
+
+# ----------------------------------------------------------------- forward
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr,
+                *, scale, causal, q_offset, block_q, block_k, num_kv_blocks):
+    i = pl.program_id(2)  # q block
+    j = pl.program_id(3)  # kv block (innermost: scratch persists across it)
+
+    @pl.when(j == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    # causal skip: block fully masked iff smallest q pos < smallest kv pos
+    q_start = i * block_q + q_offset
+    kv_start = j * block_k
+    run = (not causal) or (q_start + block_q - 1 >= kv_start)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)  # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)  # [bk, d]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [bq, bk]
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kv_pos = kv_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
+        m_prev = m_scr[:, 0]  # [bq]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[:, 0] = corr * l_scr[:, 0] + jnp.sum(p, axis=1)
+        acc[:] = corr[:, None] * acc[:] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:, 0] = m_new
+
+    @pl.when(j == num_kv_blocks - 1)
+    def _finish():
+        l = l_scr[:, 0]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc[:] / safe_l[:, None]).astype(o_ref.dtype)
+        lse_ref[0, 0, :, 0] = m_scr[:, 0] + jnp.log(safe_l)
+
+
+def _fwd(q, k, v, scale, causal, q_offset, block_q, block_k):
+    """q,k,v: [B, H, S, D] (kv may have fewer heads). Returns (o, lse)."""
+    b, h, sq, d = q.shape
+    hk = k.shape[1]
+    n_rep = h // hk
+    bq = min(block_q, sq)
+    bk = min(block_k, k.shape[2])
+    nq, nk = sq // bq, k.shape[2] // bk
+    grid = (b, h, nq, nk)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, q_offset=q_offset,
+        block_q=bq, block_k=bk, num_kv_blocks=nk,
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h_, i, j, n_rep=n_rep: (b_, h_ // n_rep, j, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h_, i, j, n_rep=n_rep: (b_, h_ // n_rep, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, i, j: (b_, h_, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------- backward
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, scale, causal, q_offset, block_q, block_k,
+                    num_q_blocks):
+    j = pl.program_id(2)  # kv block (outer)
+    i = pl.program_id(3)  # q block (inner: accumulators persist)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start = i * block_q + q_offset
+    kv_start = j * block_k
+    run = (not causal) or (q_start + block_q - 1 >= kv_start)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, 0]      # [bq]
+        delta = delta_ref[0, 0, :, 0]  # [bq] = rowsum(dO * O)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kv_pos = kv_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # [bq, bk]
+        # dV += P^T dO
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        ds = p * (dp - delta[:, None]) * scale
+        # dK += dS^T Q
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(i == num_q_blocks - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc,
+                   *, scale, causal, q_offset, block_q, block_k,
+                   num_kv_blocks):
+    i = pl.program_id(2)  # q block (outer)
+    j = pl.program_id(3)  # kv block (inner)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_start = i * block_q + q_offset
+    kv_start = j * block_k
+    run = (not causal) or (q_start + block_q - 1 >= kv_start)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, 0]
+        delta = delta_ref[0, 0, :, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kv_pos = kv_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None]) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == num_kv_blocks - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd(scale, causal, q_offset, block_q, block_k, res, do):
+    q, k, v, o, lse = res
+    b, h, sq, d = q.shape
+    hk = k.shape[1]
+    n_rep = h // hk
+    sk = k.shape[2]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    nq, nk = sq // bq, sk // bk
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [B,H,Sq,1]
+
+    # pass 1: dK, dV — grid over kv blocks, accumulate over q blocks.
+    # GQA: compute per-Q-head dk/dv at [B, H, Sk, D], then segment-sum the
+    # rep groups down to [B, Hk, Sk, D] outside the kernel (one reshape-sum).
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, scale=scale, causal=causal, q_offset=q_offset,
+        block_q=bq, block_k=bk, num_q_blocks=nq,
+    )
+    dk_full, dv_full = pl.pallas_call(
+        dkv_kernel,
+        grid=(b, h, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, j, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h_, j, i, n_rep=n_rep: (b_, h_ // n_rep, j, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h_, j, i, n_rep=n_rep: (b_, h_ // n_rep, j, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, j, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, j, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, j, i: (b_, h_, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, j, i: (b_, h_, j, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, j, i: (b_, h_, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    if n_rep > 1:
+        dk = dk_full.reshape(b, hk, n_rep, sk, d).sum(axis=2)
+        dv = dv_full.reshape(b, hk, n_rep, sk, d).sum(axis=2)
+    else:
+        dk, dv = dk_full, dv_full
+
+    # pass 2: dQ — grid over q blocks, accumulate over kv blocks.
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, scale=scale, causal=causal, q_offset=q_offset,
+        block_q=bq, block_k=bk, num_kv_blocks=nk,
+    )
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h_, i, j, n_rep=n_rep: (b_, h_ // n_rep, j, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h_, i, j, n_rep=n_rep: (b_, h_ // n_rep, j, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, i, j: (b_, h_, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------------ public
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_bhsd(q, k, v, scale, causal, q_offset, block_q, block_k):
+    o, _ = _fwd(q, k, v, scale, causal, q_offset, block_q, block_k)
+    return o
+
+
+def _flash_fwd_rule(q, k, v, scale, causal, q_offset, block_q, block_k):
+    o, lse = _fwd(q, k, v, scale, causal, q_offset, block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd_rule(scale, causal, q_offset, block_q, block_k, res, do):
+    return _bwd(scale, causal, q_offset, block_q, block_k, res, do)
+
+
+_flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention_pallas(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    q_offset: int = 0,
+    scale: float | None = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+) -> jnp.ndarray:
+    """Flash attention on [B, S, H, D] tensors (framework layout)."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    qt = q.transpose(0, 2, 1, 3)  # [B, H, S, D]
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    o = _flash_bhsd(qt, kt, vt, scale, causal, q_offset, block_q, block_k)
+    return o.transpose(0, 2, 1, 3)

@@ -1,0 +1,54 @@
+"""Rotary position embeddings (RoPE), Llama-3 style.
+
+Pure jnp: XLA fuses the sin/cos + elementwise rotate into surrounding ops;
+a hand kernel buys nothing here (HBM-bound elementwise work that already
+fuses into the attention projections).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(
+    head_dim: int,
+    max_seq_len: int,
+    theta: float = 500000.0,
+    dtype=jnp.float32,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Precompute (cos, sin) tables of shape [max_seq_len, head_dim//2].
+
+    theta=500000 is the Llama-3 base (10000 is the classic RoPE base).
+    """
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    t = jnp.arange(max_seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)  # [S, D/2]
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rope(
+    x: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    positions: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Rotate query/key tensor x: [..., S, H, D] with tables [S_max, D/2].
+
+    `positions` ([..., S] int) selects rows of the tables; defaults to
+    arange(S) (i.e. sequence-start at 0 — pass explicit positions for
+    sequence-parallel shards or KV-cache decoding).
+    """
+    seq_len = x.shape[-3]
+    if positions is None:
+        c = cos[:seq_len]  # [S, D/2]
+        s = sin[:seq_len]
+    else:
+        c = cos[positions]  # [..., S, D/2]
+        s = sin[positions]
+    # broadcast over the head axis: [..., S, 1, D/2]
+    c = c[..., :, None, :]
+    s = s[..., :, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    rotated = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return rotated.astype(x.dtype)

@@ -27,7 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ray_lightning_tpu.parallel import mesh as mesh_lib
 from ray_lightning_tpu.utils import get_logger
-from ray_lightning_tpu.utils.pytree import _path_str
+from ray_lightning_tpu.utils.pytree import _path_str, named_leaves as _named_leaves
 
 log = get_logger(__name__)
 
@@ -136,6 +136,42 @@ class Strategy:
             out.pop()
         return P(*out)
 
+    def opt_state_shardings(self, abstract_opt, params) -> Any:
+        """Shardings for the optimizer state: param-shaped leaves (adam
+        mu/nu, momentum, …) inherit their param's sharding — ZeRO
+        semantics; scalars/schedules replicate.
+
+        Without this, `jit(tx.init)` leaves the whole opt state on one
+        device (the init is shape-only, so XLA drops the input dependency
+        and with it the sharding propagation).
+
+        Opt-state pytrees embed param subtrees (optax builds them with
+        `tree_map(zeros_like, params)`), so each opt leaf is matched to
+        the param whose full path is the longest suffix of the opt leaf's
+        path and whose shape agrees.
+        """
+        assert self.mesh is not None, "call setup() first"
+        param_shardings = self.param_shardings(params)
+        by_path = {}
+        for (path, leaf), sharding in zip(
+            _named_leaves(params), jax.tree.leaves(param_shardings)
+        ):
+            by_path[path] = (getattr(leaf, "shape", ()), sharding)
+        replicated = self.replicated()
+
+        def one(path: str, leaf):
+            parts = path.split("/")
+            for i in range(len(parts)):
+                cand = "/".join(parts[i:])
+                hit = by_path.get(cand)
+                if hit and hit[0] == getattr(leaf, "shape", ()):
+                    return hit[1]
+            return replicated
+
+        return jax.tree_util.tree_map_with_path(
+            lambda kp, leaf: one(_path_str(kp), leaf), abstract_opt
+        )
+
     def batch_spec(self) -> P:
         assert self.mesh is not None
         return P(mesh_lib.dp_axis_names(self.mesh))
@@ -225,13 +261,7 @@ class FSDP(Strategy):
         )
 
     def _adapt_spec(self, spec: P, shape) -> P:
-        spec = super()._adapt_spec(spec, shape)
-        # Module-provided tensor specs still get FSDP'd on a free axis.
-        if self.mesh.shape.get("fsdp", 1) > 1 and "fsdp" not in _spec_names(spec):
-            spec = _augment_with_axis(
-                spec, shape, "fsdp", self.mesh.shape["fsdp"], self.min_shard_size
-            )
-        return spec
+        return _fsdp_adapt_spec(self, spec, shape)
 
 
 class ShardedMesh(Strategy):
@@ -266,7 +296,8 @@ class ShardedMesh(Strategy):
             self.min_shard_size,
         )
 
-    _adapt_spec = FSDP._adapt_spec
+    def _adapt_spec(self, spec: P, shape) -> P:
+        return _fsdp_adapt_spec(self, spec, shape)
 
 
 class SingleDevice(Strategy):
@@ -291,6 +322,19 @@ class RayXlaPlugin(DataParallel):
 
 
 # ---- spec helpers --------------------------------------------------------
+
+
+def _fsdp_adapt_spec(strategy: Strategy, spec: P, shape) -> P:
+    """Shared FSDP/ShardedMesh adapt: drop trivial axes, then overlay
+    `fsdp` on a free divisible dim of module-provided tensor specs."""
+    spec = Strategy._adapt_spec(strategy, spec, shape)
+    if (strategy.mesh.shape.get("fsdp", 1) > 1
+            and "fsdp" not in _spec_names(spec)):
+        spec = _augment_with_axis(
+            spec, shape, "fsdp", strategy.mesh.shape["fsdp"],
+            strategy.min_shard_size,
+        )
+    return spec
 
 
 def _spec_names(spec: P) -> set:

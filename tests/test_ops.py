@@ -1,0 +1,167 @@
+"""Kernel correctness: flash attention + rmsnorm vs jnp references.
+
+Pallas kernels run in interpret mode on the CPU test harness, so the same
+kernel code the TPU executes is what's checked here.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.ops.attention import (
+    dot_product_attention,
+    flash_attention,
+)
+from ray_lightning_tpu.ops.norms import rms_norm
+from ray_lightning_tpu.ops.pallas.flash import (
+    flash_attention_pallas,
+    shapes_supported,
+)
+from ray_lightning_tpu.ops.pallas.rmsnorm import rms_norm_pallas
+from ray_lightning_tpu.ops.rope import apply_rope, rope_frequencies
+
+
+def _qkv(B=2, S=256, H=4, Hk=2, D=64, seed=0):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((B, S, H, D), dtype=np.float32))
+    k = jnp.asarray(rng.standard_normal((B, S, Hk, D), dtype=np.float32))
+    v = jnp.asarray(rng.standard_normal((B, S, Hk, D), dtype=np.float32))
+    return q, k, v
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_forward_matches_reference(self, causal):
+        q, k, v = _qkv()
+        ref = dot_product_attention(q, k, v, causal=causal)
+        out = flash_attention_pallas(q, k, v, causal=causal,
+                                     block_q=128, block_k=128)
+        np.testing.assert_allclose(out, ref, atol=2e-5)
+
+    def test_backward_matches_reference(self):
+        q, k, v = _qkv()
+
+        def loss_ref(q, k, v):
+            return (dot_product_attention(q, k, v) ** 2).sum()
+
+        def loss_flash(q, k, v):
+            return (flash_attention_pallas(
+                q, k, v, block_q=128, block_k=128) ** 2).sum()
+
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gr, gf):
+            scale = float(jnp.abs(a).max())
+            np.testing.assert_allclose(b, a, atol=3e-5 * max(scale, 1.0))
+
+    def test_mha_no_gqa(self):
+        q, k, v = _qkv(H=4, Hk=4)
+        ref = dot_product_attention(q, k, v)
+        out = flash_attention_pallas(q, k, v, block_q=128, block_k=128)
+        np.testing.assert_allclose(out, ref, atol=2e-5)
+
+    def test_q_offset_decode_shard(self):
+        """A query shard starting mid-sequence masks correctly."""
+        q, k, v = _qkv(S=256)
+        q_half = q[:, 128:]
+        ref = dot_product_attention(q_half, k, v, causal=True, q_offset=128)
+        out = flash_attention_pallas(q_half, k, v, causal=True, q_offset=128,
+                                     block_q=128, block_k=128)
+        np.testing.assert_allclose(out, ref, atol=2e-5)
+
+    def test_shapes_supported_gate(self):
+        assert shapes_supported((2, 256, 4, 128), (2, 256, 4, 128))
+        assert shapes_supported((2, 256, 4, 64), (2, 256, 2, 64))
+        assert not shapes_supported((2, 250, 4, 128), (2, 250, 4, 128))
+        assert not shapes_supported((2, 256, 4, 100), (2, 256, 4, 100))
+        assert not shapes_supported((2, 256, 3, 128), (2, 256, 2, 128))
+
+    def test_dispatch_falls_back_off_tpu(self):
+        """flash_attention auto-dispatch returns reference results on CPU."""
+        q, k, v = _qkv(S=64)
+        out = flash_attention(q, k, v)
+        ref = dot_product_attention(q, k, v)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_padding_mask(self):
+        q, k, v = _qkv(S=64)
+        mask = jnp.asarray(
+            np.random.default_rng(1).integers(0, 2, (2, 64)).astype(bool)
+        )
+        mask = mask.at[:, 0].set(True)  # row 0 visible so no all-masked rows
+        out = dot_product_attention(q, k, v, causal=True, mask=mask)
+        assert out.shape == q.shape
+        assert bool(jnp.isfinite(out).all())
+
+
+class TestRMSNorm:
+    def test_forward(self):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((4, 128, 256), dtype=np.float32))
+        w = jnp.asarray(rng.standard_normal(256, dtype=np.float32))
+        ref = rms_norm(x, w, use_pallas=False)
+        out = rms_norm_pallas(x, w)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_backward(self):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((2, 64, 128), dtype=np.float32))
+        w = jnp.asarray(rng.standard_normal(128, dtype=np.float32))
+        g1 = jax.grad(lambda x, w: (rms_norm(x, w, use_pallas=False) ** 2).sum(),
+                      argnums=(0, 1))(x, w)
+        g2 = jax.grad(lambda x, w: (rms_norm_pallas(x, w) ** 2).sum(),
+                      argnums=(0, 1))(x, w)
+        np.testing.assert_allclose(g2[0], g1[0], atol=1e-4)
+        np.testing.assert_allclose(g2[1], g1[1], atol=1e-3)
+
+    def test_bf16(self):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((8, 128)), dtype=jnp.bfloat16)
+        w = jnp.ones(128, jnp.bfloat16)
+        out = rms_norm_pallas(x, w)
+        assert out.dtype == jnp.bfloat16
+
+
+class TestRope:
+    def test_norm_preserved(self):
+        """Rotation preserves pairwise norms."""
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((2, 16, 4, 64), dtype=np.float32))
+        cos, sin = rope_frequencies(64, 32)
+        y = apply_rope(x, cos, sin)
+        np.testing.assert_allclose(
+            jnp.linalg.norm(y, axis=-1), jnp.linalg.norm(x, axis=-1),
+            rtol=1e-5,
+        )
+
+    def test_position_zero_identity(self):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((1, 1, 2, 32), dtype=np.float32))
+        cos, sin = rope_frequencies(32, 8)
+        y = apply_rope(x, cos, sin)
+        np.testing.assert_allclose(y, x, atol=1e-6)
+
+    def test_explicit_positions(self):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((1, 4, 2, 32), dtype=np.float32))
+        cos, sin = rope_frequencies(32, 16)
+        shifted = apply_rope(x, cos, sin, positions=jnp.arange(4) + 8)
+        full = apply_rope(
+            jnp.concatenate([jnp.zeros((1, 8, 2, 32), x.dtype), x], axis=1),
+            cos, sin,
+        )[:, 8:]
+        np.testing.assert_allclose(shifted, full, atol=1e-5)
+
+    def test_relative_property(self):
+        """Attention scores depend only on relative positions."""
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.standard_normal((1, 1, 1, 64), dtype=np.float32))
+        k = jnp.asarray(rng.standard_normal((1, 1, 1, 64), dtype=np.float32))
+        cos, sin = rope_frequencies(64, 64)
+
+        def score(qpos, kpos):
+            qr = apply_rope(q, cos, sin, positions=jnp.array([qpos]))
+            kr = apply_rope(k, cos, sin, positions=jnp.array([kpos]))
+            return float(jnp.sum(qr * kr))
+
+        assert abs(score(5, 3) - score(10, 8)) < 1e-4
