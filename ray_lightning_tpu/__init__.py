@@ -29,6 +29,12 @@ from ray_lightning_tpu.parallel import (
     Strategy,
     make_mesh,
 )
+from ray_lightning_tpu.runtime import (
+    WorkerError,
+    WorkerGroup,
+    launch,
+    launch_cpu_spmd,
+)
 from ray_lightning_tpu.utils import seed_everything, simulate_cpu_devices
 
 __version__ = "0.1.0"
@@ -52,6 +58,10 @@ __all__ = [
     "RayXlaPlugin",
     "MeshSpec",
     "make_mesh",
+    "WorkerError",
+    "WorkerGroup",
+    "launch",
+    "launch_cpu_spmd",
     "seed_everything",
     "simulate_cpu_devices",
     "__version__",

@@ -173,7 +173,6 @@ def llama_param_specs(cfg: LlamaConfig) -> Dict[str, P]:
     The strategies overlay `fsdp` on whatever axis is still free.
     """
     st = cfg.scan_layers
-    prefix = "layers" if st else None  # non-scan handled by suffix matching
     specs: Dict[str, P] = {
         "tok_embed/embedding": P("tensor", None),
         "final_norm": P(),

@@ -12,12 +12,8 @@ repeating KV heads (XLA turns the repeat into a broadcast, no HBM copy).
 """
 from __future__ import annotations
 
-import functools
-import os
-
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 
 def make_causal_mask(q_len: int, kv_len: int, q_offset: int = 0) -> jnp.ndarray:
@@ -64,15 +60,14 @@ def dot_product_attention(
         if mask.ndim == 2:
             mask = mask[:, None, None, :]
         scores = jnp.where(mask, scores, -jnp.inf)
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    # Rows with no visible key (fully-padded sequence, or padding ∩ causal
+    # leaving nothing) would softmax over all -inf → NaN; emit zeros there.
+    any_visible = jnp.isfinite(scores).any(axis=-1, keepdims=True)
+    probs = jax.nn.softmax(
+        jnp.where(any_visible, scores, 0.0), axis=-1
+    ).astype(q.dtype)
+    probs = jnp.where(any_visible, probs, 0.0).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
-
-
-def _on_tpu() -> bool:
-    try:
-        return jax.default_backend() == "tpu"
-    except Exception:
-        return False
 
 
 def flash_attention(
@@ -88,9 +83,9 @@ def flash_attention(
     (or forced via RLT_PALLAS=1 with interpret mode on CPU) and the shape
     tiles cleanly; otherwise the XLA reference path (which XLA still fuses
     reasonably — flash matters at long S where the S×S scores don't fit)."""
-    if use_pallas is None:
-        env = os.environ.get("RLT_PALLAS")
-        use_pallas = _on_tpu() if env is None else env == "1"
+    from ray_lightning_tpu.ops import dispatch
+
+    use_pallas = dispatch.use_pallas(use_pallas)
     if use_pallas and mask is None:
         from ray_lightning_tpu.ops.pallas.flash import (
             flash_attention_pallas,

@@ -1,0 +1,34 @@
+"""Single home for the kernel-dispatch policy.
+
+All ops decide "pallas TPU kernel vs XLA reference path" the same way; a
+future backend (or a forced-interpret env knob) changes here only.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def on_tpu() -> bool:
+    """True when the default backend is a real TPU."""
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # backend init failure → reference path
+        return False
+
+
+def interpret_mode() -> bool:
+    """Pallas kernels run in interpret mode everywhere but TPU (so tests
+    exercise kernel logic on the CPU mesh)."""
+    return not on_tpu()
+
+
+def use_pallas(override: bool | None = None) -> bool:
+    """Dispatch decision: explicit argument > RLT_PALLAS env > backend."""
+    if override is not None:
+        return override
+    env = os.environ.get("RLT_PALLAS")
+    if env is not None:
+        return env == "1"
+    return on_tpu()

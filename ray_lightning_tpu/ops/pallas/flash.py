@@ -32,8 +32,7 @@ DEFAULT_BLOCK_K = 256
 _NEG_INF = -1e30  # avoid true -inf: exp(-inf - -inf) = nan on fully-masked rows
 
 
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+from ray_lightning_tpu.ops.dispatch import interpret_mode as _interpret
 
 
 def shapes_supported(q_shape, k_shape) -> bool:

@@ -14,8 +14,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+from ray_lightning_tpu.ops.dispatch import interpret_mode as _interpret
 
 
 def _kernel(x_ref, w_ref, o_ref, *, eps):

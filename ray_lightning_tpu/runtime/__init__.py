@@ -1,0 +1,41 @@
+"""Runtime substrate (L0' of SURVEY §7.2): worker launch, env bootstrap,
+closure shipping, result futures, side-channel queue, SPMD coordination.
+
+Replaces the reference's use of Ray core (actors/object store/queue actor,
+reference ray_ddp.py:17-39,106-213, util.py:22-109, session.py:1-63) with
+subprocesses + multiprocessing.connection + cloudpickle, and the
+MASTER_ADDR/PORT rendezvous (ray_ddp.py:152-156) with a jax.distributed
+coordinator.
+"""
+from ray_lightning_tpu.runtime.group import (
+    TpuExecutor,
+    WorkerError,
+    WorkerGroup,
+    find_free_port,
+)
+from ray_lightning_tpu.runtime.launch import launch, launch_cpu_spmd
+from ray_lightning_tpu.runtime.session import (
+    get_actor_rank,
+    get_session,
+    get_world_size,
+    init_session,
+    is_session_enabled,
+    put_queue,
+    reset_session,
+)
+
+__all__ = [
+    "TpuExecutor",
+    "WorkerError",
+    "WorkerGroup",
+    "find_free_port",
+    "launch",
+    "launch_cpu_spmd",
+    "get_actor_rank",
+    "get_session",
+    "get_world_size",
+    "init_session",
+    "is_session_enabled",
+    "put_queue",
+    "reset_session",
+]

@@ -1,0 +1,371 @@
+"""Driver-side runtime substrate: worker processes, futures, result pump.
+
+This is the rebuild of the reference's L2/L0 usage of Ray core — actor
+creation with resource options (reference ray_ddp.py:106-119), env-var
+injection (:158-164), fan-out of ``train_remote`` (:178-182), the
+``process_results`` future/queue pump (reference util.py:96-109), and
+teardown (:201-213) — with plain subprocesses + ``multiprocessing.connection``
+instead of Ray's GCS/raylet/plasma, and ``connection.wait`` (a real select)
+instead of the reference's ``ray.wait(timeout=0)`` busy-poll
+(a consciously-fixed quirk, SURVEY §2.4).
+
+Pieces:
+  * TpuExecutor  — handle to ONE worker process (RayExecutor analog,
+    reference ray_ddp.py:17-39): execute/execute_async, set_env_vars,
+    get_node_ip, kill.
+  * WorkerGroup  — N executors + the pump: run() fans a closure to every
+    rank, pumps side-channel items (executing callables driver-side, the
+    trampoline of reference util.py:88-93), gathers per-rank results,
+    fail-fast on the first worker error (reference failure model, §5.3).
+"""
+from __future__ import annotations
+
+import os
+import secrets
+import socket
+import subprocess
+import sys
+import time
+from multiprocessing.connection import Connection, Listener, wait as conn_wait
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import cloudpickle
+
+from ray_lightning_tpu.utils import get_logger
+
+log = get_logger(__name__)
+
+_WORKER_PATH = os.path.join(os.path.dirname(__file__), "worker.py")
+
+
+def find_free_port(host: str = "127.0.0.1") -> int:
+    """Reference analog: ray_ddp.py:152-156's MASTER_PORT discovery — here
+    used for the driver listener and the jax.distributed coordinator."""
+    s = socket.socket()
+    s.bind((host, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class WorkerError(RuntimeError):
+    def __init__(self, rank: int, traceback_str: str, log_tail: str = ""):
+        self.rank = rank
+        self.traceback_str = traceback_str
+        msg = f"worker rank {rank} failed:\n{traceback_str}"
+        if log_tail:
+            msg += f"\n--- worker log tail ---\n{log_tail}"
+        super().__init__(msg)
+
+
+class TpuExecutor:
+    """One remote worker process (reference RayExecutor, ray_ddp.py:17-39)."""
+
+    def __init__(self, rank: int, world: int, proc: subprocess.Popen,
+                 conn: Connection, info: Dict[str, Any], log_path: str):
+        self.rank = rank
+        self.world = world
+        self.proc = proc
+        self.conn = conn
+        self.info = info
+        self.log_path = log_path
+        self._next_tid = 0
+
+    # -- RayExecutor API parity -------------------------------------------
+    def set_env_vars(self, env: Dict[str, str]) -> None:
+        """reference ray_ddp.py:27-31 (no ack needed: FIFO ordering)."""
+        self.conn.send(("env", dict(env)))
+
+    def get_node_ip(self) -> str:
+        """reference ray_ddp.py:33-35."""
+        return self.info.get("ip", "127.0.0.1")
+
+    def execute_async(self, fn: Callable, *args, **kwargs) -> int:
+        """Ship a closure; returns a task id to await via WorkerGroup."""
+        tid = self._next_tid
+        self._next_tid += 1
+        blob = cloudpickle.dumps((fn, args, kwargs))
+        self.conn.send(("exec", tid, blob))
+        return tid
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def log_tail(self, n: int = 40) -> str:
+        try:
+            with open(self.log_path, "r", errors="replace") as f:
+                return "".join(f.readlines()[-n:])
+        except OSError:
+            return ""
+
+    def kill(self) -> None:
+        if self.alive():
+            self.proc.kill()
+        try:
+            self.conn.close()
+        except OSError:
+            pass
+
+
+class WorkerGroup:
+    """N worker processes + the result/queue pump.
+
+    Lifecycle mirrors the reference plugin's setup/start_training/
+    post_dispatch (ray_ddp.py:113-213):
+
+        group = WorkerGroup(num_workers=4, env={...}, init_hook=fn)
+        group.start()                      # spawn + hello + init_hook
+        results = group.run(train_fn)      # fan-out, pump, gather
+        group.shutdown()                   # graceful, then kill
+    """
+
+    def __init__(
+        self,
+        num_workers: int,
+        env: Optional[Dict[str, str]] = None,
+        init_hook: Optional[Callable[[], None]] = None,
+        log_dir: Optional[str] = None,
+        start_timeout: float = 120.0,
+    ):
+        if num_workers < 1:
+            raise ValueError("num_workers must be >= 1")
+        self.num_workers = num_workers
+        self.env = dict(env or {})
+        self.init_hook = init_hook
+        self.log_dir = log_dir or os.path.join(
+            os.getcwd(), "rlt_logs", "workers"
+        )
+        self.start_timeout = start_timeout
+        self.executors: List[TpuExecutor] = []
+        self._listener: Optional[Listener] = None
+        self._queue_items: List[Any] = []
+
+    # ------------------------------------------------------------- launch
+    def start(self) -> "WorkerGroup":
+        os.makedirs(self.log_dir, exist_ok=True)
+        authkey = secrets.token_bytes(32)
+        self._listener = Listener(("127.0.0.1", 0), authkey=authkey)
+        host, port = self._listener.address
+        procs: Dict[int, subprocess.Popen] = {}
+        logs: Dict[int, str] = {}
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        for rank in range(self.num_workers):
+            wenv = dict(os.environ)
+            wenv.update(self.env)
+            wenv["RLT_WORKER_AUTHKEY"] = authkey.hex()
+            # Make the package importable in the worker no matter where the
+            # driver was launched from (env bootstrap, C7 of SURVEY §7.1).
+            wenv["PYTHONPATH"] = (
+                repo_root + os.pathsep + wenv.get("PYTHONPATH", "")
+            )
+            log_path = os.path.join(self.log_dir, f"worker-{rank}.log")
+            logs[rank] = log_path
+            logf = open(log_path, "w")
+            procs[rank] = subprocess.Popen(
+                [sys.executable, "-u", _WORKER_PATH,
+                 host, str(port), str(rank), str(self.num_workers)],
+                env=wenv, stdout=logf, stderr=subprocess.STDOUT,
+            )
+            logf.close()
+        # Accept hellos; connections arrive in arbitrary order — the hello
+        # message carries the rank (cf. reference get_local_ranks building
+        # the rank map driver-side, ray_ddp.py:130-141).
+        by_rank: Dict[int, TpuExecutor] = {}
+        deadline = time.monotonic() + self.start_timeout
+        for _ in range(self.num_workers):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                self._abort_start(procs, logs)
+                raise TimeoutError(
+                    f"workers did not all connect within {self.start_timeout}s"
+                )
+            # Listener.accept has no timeout; poll the underlying socket.
+            self._listener._listener._socket.settimeout(remaining)
+            try:
+                conn = self._listener.accept()
+            except socket.timeout:
+                self._abort_start(procs, logs)
+                raise TimeoutError(
+                    f"workers did not all connect within {self.start_timeout}s"
+                ) from None
+            cmd, rank, info = conn.recv()
+            assert cmd == "hello", cmd
+            by_rank[rank] = TpuExecutor(
+                rank, self.num_workers, procs[rank], conn, info, logs[rank]
+            )
+        self.executors = [by_rank[r] for r in range(self.num_workers)]
+        if self.init_hook is not None:
+            # reference ray_ddp.py:118-119: run init_hook on every worker
+            # and wait for completion before training starts.
+            self.run(self.init_hook)
+        return self
+
+    def _abort_start(self, procs, logs) -> None:
+        tails = []
+        for rank, p in procs.items():
+            if p.poll() is not None:
+                try:
+                    with open(logs[rank], errors="replace") as f:
+                        tails.append(
+                            f"rank {rank} exited rc={p.returncode}:\n"
+                            + "".join(f.readlines()[-20:])
+                        )
+                except OSError:
+                    pass
+            p.kill()
+        if tails:
+            log.error("worker startup failure:\n%s", "\n".join(tails))
+
+    # --------------------------------------------------------------- exec
+    def set_env_vars(self, env: Dict[str, str]) -> None:
+        for ex in self.executors:
+            ex.set_env_vars(env)
+
+    def run(
+        self,
+        fn: Callable,
+        per_rank_args: Optional[Sequence[Sequence[Any]]] = None,
+        on_queue_item: Optional[Callable[[int, Any], None]] = None,
+        timeout: Optional[float] = None,
+    ) -> List[Any]:
+        """Fan ``fn`` out to every rank and pump until all return.
+
+        The pump is the reference's ``process_results`` (util.py:96-109)
+        rebuilt on a real select: side-channel items are handled as they
+        arrive (callables executed driver-side — the tune.report trampoline,
+        util.py:88-93), the first worker error raises WorkerError
+        (fail-fast, SURVEY §5.3), and remaining results are gathered in
+        rank order.
+        """
+        assert self.executors, "call start() first"
+        tids = []
+        for rank, ex in enumerate(self.executors):
+            args = per_rank_args[rank] if per_rank_args is not None else ()
+            tids.append(ex.execute_async(fn, *args))
+        return self.wait(tids, on_queue_item=on_queue_item, timeout=timeout)
+
+    def wait(
+        self,
+        tids: Sequence[int],
+        on_queue_item: Optional[Callable[[int, Any], None]] = None,
+        timeout: Optional[float] = None,
+    ) -> List[Any]:
+        results: Dict[int, Any] = {}
+        done: Dict[int, bool] = {r: False for r in range(self.num_workers)}
+        deadline = (
+            (time.monotonic() + timeout) if timeout is not None else None
+        )
+        conns = {ex.conn: ex for ex in self.executors}
+        while not all(done.values()):
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"workers still pending: "
+                                   f"{[r for r, d in done.items() if not d]}")
+            ready = conn_wait(list(conns), timeout=1.0)
+            if not ready:
+                self._check_liveness(done)
+                continue
+            for conn in ready:
+                ex = conns[conn]
+                try:
+                    msg = conn.recv()
+                except EOFError:
+                    raise WorkerError(
+                        ex.rank, "worker process died (EOF on channel)",
+                        ex.log_tail(),
+                    ) from None
+                self._dispatch(msg, ex, tids, results, done, on_queue_item)
+        self.drain_queue(on_queue_item)
+        return [results[r] for r in range(self.num_workers)]
+
+    def _dispatch(self, msg, ex, tids, results, done, on_queue_item) -> None:
+        cmd = msg[0]
+        if cmd == "result":
+            tid, blob = msg[1], msg[2]
+            if tid == tids[ex.rank]:
+                results[ex.rank] = cloudpickle.loads(blob)
+                done[ex.rank] = True
+        elif cmd == "error":
+            # Stale errors from an earlier, already-raised run stay buffered
+            # on the other ranks' connections; only raise for THIS task.
+            if msg[1] == tids[ex.rank]:
+                raise WorkerError(ex.rank, msg[2], ex.log_tail())
+            log.warning(
+                "dropping stale error from rank %d (task %s): %s",
+                ex.rank, msg[1], msg[2].splitlines()[-1] if msg[2] else "",
+            )
+        elif cmd == "queue":
+            rank, item = cloudpickle.loads(msg[1])
+            self._handle_queue_item(rank, item, on_queue_item)
+        elif cmd == "bye":
+            done[ex.rank] = True
+
+    def _handle_queue_item(self, rank, item, on_queue_item) -> None:
+        """The trampoline (reference util.py:88-93): callables run here, in
+        the driver process — this is how tune.report-style closures created
+        on worker rank 0 execute inside the driver's sweep session."""
+        if on_queue_item is not None:
+            on_queue_item(rank, item)
+        elif callable(item):
+            item()
+        else:
+            self._queue_items.append((rank, item))
+
+    def drain_queue(self, on_queue_item=None) -> None:
+        """Post-completion drain (reference util.py:106-109)."""
+        for conn, ex in {ex.conn: ex for ex in self.executors}.items():
+            while conn.poll(0):
+                try:
+                    msg = conn.recv()
+                except EOFError:
+                    break
+                if msg[0] == "queue":
+                    rank, item = cloudpickle.loads(msg[1])
+                    self._handle_queue_item(rank, item, on_queue_item)
+
+    def queue_items(self) -> List[Any]:
+        items, self._queue_items = self._queue_items, []
+        return items
+
+    def _check_liveness(self, done) -> None:
+        for ex in self.executors:
+            if not done[ex.rank] and not ex.alive():
+                raise WorkerError(
+                    ex.rank,
+                    f"worker process exited rc={ex.proc.returncode} "
+                    "without returning a result",
+                    ex.log_tail(),
+                )
+
+    # ------------------------------------------------------------ teardown
+    def shutdown(self, timeout: float = 10.0) -> None:
+        """Graceful shutdown, then kill — reference post_dispatch
+        (ray_ddp.py:201-213) with `ray.kill` replaced by SIGKILL."""
+        for ex in self.executors:
+            if ex.alive():
+                try:
+                    ex.conn.send(("shutdown",))
+                except (BrokenPipeError, OSError):
+                    pass
+        deadline = time.monotonic() + timeout
+        for ex in self.executors:
+            remaining = max(0.0, deadline - time.monotonic())
+            try:
+                ex.proc.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                ex.kill()
+            try:
+                ex.conn.close()
+            except OSError:
+                pass
+        if self._listener is not None:
+            self._listener.close()
+            self._listener = None
+        self.executors = []
+
+    def __enter__(self) -> "WorkerGroup":
+        return self.start() if not self.executors else self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()

@@ -1,0 +1,79 @@
+"""Worker-local session registry: rank + report side channel.
+
+Rebuild of the reference's per-worker singleton
+(reference ray_lightning/session.py:1-63): Tune-style callbacks running
+deep inside the fit loop need the worker's rank and a handle to the
+driver-bound queue WITHOUT those being plumbed through every call —
+a process-global registry, double-init guarded (reference session.py:30-36).
+
+Here the "queue" is the worker's duplex channel back to the driver
+(bound by runtime/worker.py before user code runs); items are tagged with
+the sending rank (reference session.py:17-24) and, if callable, executed
+driver-side by the pump's trampoline (reference util.py:88-93).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+class TpuSession:
+    def __init__(self, rank: int, world_size: int, queue: Optional[Any]):
+        self.rank = rank
+        self.world_size = world_size
+        self.queue = queue
+
+    def put_queue(self, item: Any) -> None:
+        if self.queue is None:
+            raise ValueError(
+                "this session has no report queue attached "
+                "(reference analog: session.py:21-24)"
+            )
+        self.queue.put_queue(item)
+
+
+_session: Optional[TpuSession] = None
+
+
+def init_session(rank: int, world_size: int = 1, queue: Optional[Any] = None,
+                 _overwrite: bool = True) -> None:
+    """Bind the process-global session. Unlike the reference (which raises
+    on double init, session.py:30-36) re-binding is allowed so a worker
+    process can be reused across execs; pass _overwrite=False for the
+    strict behavior."""
+    global _session
+    if _session is not None and not _overwrite:
+        raise ValueError("a session already exists in this process")
+    _session = TpuSession(rank, world_size, queue)
+
+
+def get_session() -> Optional[TpuSession]:
+    return _session
+
+
+def reset_session() -> None:
+    global _session
+    _session = None
+
+
+def is_session_enabled() -> bool:
+    """True iff running inside a runtime worker (reference analog:
+    tune.is_session_enabled, tune.py:14-22)."""
+    return _session is not None
+
+
+def get_actor_rank() -> int:
+    """Rank of this worker (reference session.py:56-58)."""
+    assert _session is not None, "init_session must be called first"
+    return _session.rank
+
+
+def get_world_size() -> int:
+    assert _session is not None, "init_session must be called first"
+    return _session.world_size
+
+
+def put_queue(item: Any) -> None:
+    """Ship an item to the driver's pump (reference session.py:61-63).
+    Callables are executed driver-side — the tune.report trampoline."""
+    assert _session is not None, "init_session must be called first"
+    _session.put_queue(item)

@@ -1,0 +1,118 @@
+"""Worker process entrypoint — the remote half of the runtime substrate.
+
+This file is executed BY PATH (``python /.../worker.py host port rank world``),
+never via ``-m``, so that nothing imports ``ray_lightning_tpu`` (and hence
+``jax``) before the shipped closure has a chance to set platform/device-count
+config. It is intentionally stdlib + cloudpickle only.
+
+Reference analog: the ``RayExecutor`` actor body
+(reference ray_lightning/ray_ddp.py:17-39) — a generic remote-execution
+process that can run arbitrary functions (``execute``, :37), accept env-var
+injection (``set_env_vars``, :27) and report its node IP (``get_node_ip``,
+:33). Ray actors are replaced by plain subprocesses + a
+``multiprocessing.connection`` duplex channel back to the driver; the Ray
+object store is replaced by cloudpickle blobs over that channel.
+
+Wire protocol (all messages are tuples, first element is the command):
+  driver -> worker:
+    ("env", {k: v})            merge into os.environ (no ack; FIFO ordering
+                               guarantees later execs see it)
+    ("exec", tid, blob)        blob = cloudpickle((fn, args, kwargs));
+                               reply is ("result", tid, blob) or
+                               ("error", tid, traceback_str)
+    ("shutdown",)              reply ("bye", rank), then exit 0
+  worker -> driver:
+    ("hello", rank, info)      sent once on connect
+    ("result", tid, blob)
+    ("error", tid, tb_str)
+    ("queue", blob)            side-channel item from session.put_queue;
+                               blob = cloudpickle((rank, item))
+"""
+import os
+import socket
+import sys
+import threading
+import traceback
+from multiprocessing.connection import Client
+
+import cloudpickle
+
+
+def _node_ip() -> str:
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 80))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"
+
+
+class _WorkerChannel:
+    """Thread-safe sender shared by the exec loop and the session side
+    channel (reference session.py:17-24 tags items with rank; we do the
+    same in the blob)."""
+
+    def __init__(self, conn, rank: int, world: int):
+        self.conn = conn
+        self.rank = rank
+        self.world = world
+        self._lock = threading.Lock()
+
+    def send(self, msg) -> None:
+        with self._lock:
+            self.conn.send(msg)
+
+    def put_queue(self, item) -> None:
+        self.send(("queue", cloudpickle.dumps((self.rank, item))))
+
+
+def _bind_session(channel: _WorkerChannel) -> None:
+    """Make ray_lightning_tpu.runtime.session work inside this worker.
+
+    Deferred + best-effort: the import pulls in the package (and jax), so it
+    only happens right before user code runs — by which point the shipped
+    closure has already had its chance to set jax config at the top of its
+    own body (config updates like jax_platforms work post-import as long as
+    no backend has initialized).
+    """
+    from ray_lightning_tpu.runtime import session
+
+    session.init_session(
+        rank=channel.rank, world_size=channel.world, queue=channel
+    )
+
+
+def main(argv) -> int:
+    host, port, rank, world = argv[1], int(argv[2]), int(argv[3]), int(argv[4])
+    authkey = bytes.fromhex(os.environ.pop("RLT_WORKER_AUTHKEY"))
+    conn = Client((host, port), authkey=authkey)
+    channel = _WorkerChannel(conn, rank, world)
+    channel.send(("hello", rank, {"pid": os.getpid(), "ip": _node_ip()}))
+    session_bound = False
+    while True:
+        msg = conn.recv()
+        cmd = msg[0]
+        if cmd == "env":
+            os.environ.update(msg[1])
+        elif cmd == "exec":
+            tid, blob = msg[1], msg[2]
+            try:
+                fn, args, kwargs = cloudpickle.loads(blob)
+                if not session_bound:
+                    _bind_session(channel)
+                    session_bound = True
+                result = fn(*args, **kwargs)
+                channel.send(("result", tid, cloudpickle.dumps(result)))
+            except BaseException:
+                channel.send(("error", tid, traceback.format_exc()))
+        elif cmd == "shutdown":
+            channel.send(("bye", rank))
+            return 0
+        else:
+            channel.send(("error", -1, f"unknown command {cmd!r}"))
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

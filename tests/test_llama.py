@@ -92,8 +92,23 @@ class TestLlamaForward:
 
 class TestLlamaTraining:
     def test_dp_loss_decreases(self):
-        trainer, _ = _fit(DataParallel(num_workers=4), max_epochs=3)
-        assert float(trainer.callback_metrics["val_loss"]) < 6.0
+        from ray_lightning_tpu import Callback
+
+        class FirstLoss(Callback):
+            value = None
+
+            def on_train_batch_end(self, trainer, module, metrics, batch_idx):
+                if self.value is None and "loss" in metrics:
+                    self.value = float(metrics["loss"])
+
+        first = FirstLoss()
+        trainer, _ = _fit(DataParallel(num_workers=4), max_epochs=3,
+                          callbacks=[first], log_every_n_steps=1)
+        final = float(trainer.callback_metrics["val_loss"])
+        assert first.value is not None
+        # A genuine decrease from the recorded step-1 loss — not just
+        # "below some constant" (chance level for vocab 256 is ln(256)≈5.55).
+        assert final < first.value - 0.2, (first.value, final)
 
     def test_fsdp_sharding_applied(self, devices8):
         trainer, module = _fit(FSDP(min_shard_size=1))

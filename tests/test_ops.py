@@ -93,6 +93,17 @@ class TestFlashAttention:
         assert out.shape == q.shape
         assert bool(jnp.isfinite(out).all())
 
+    def test_fully_masked_rows_yield_zeros_not_nan(self):
+        """A sequence whose padding mask is all-False (or padding ∩ causal
+        leaving a query row with no visible key) must produce zeros, not
+        NaN from softmax over all -inf."""
+        q, k, v = _qkv(S=16)
+        mask = jnp.zeros((2, 16), dtype=bool).at[1].set(True)  # batch 0 fully padded
+        out = dot_product_attention(q, k, v, causal=True, mask=mask)
+        assert bool(jnp.isfinite(out).all())
+        np.testing.assert_allclose(np.asarray(out[0]), 0.0)
+        assert bool((jnp.abs(out[1]) > 0).any())
+
 
 class TestRMSNorm:
     def test_forward(self):
