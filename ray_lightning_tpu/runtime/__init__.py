@@ -13,6 +13,7 @@ from ray_lightning_tpu.runtime.group import (
     WorkerGroup,
     find_free_port,
 )
+from ray_lightning_tpu.runtime.fit import FitResult, fit_distributed
 from ray_lightning_tpu.runtime.launch import launch, launch_cpu_spmd
 from ray_lightning_tpu.runtime.session import (
     get_actor_rank,
@@ -25,6 +26,8 @@ from ray_lightning_tpu.runtime.session import (
 )
 
 __all__ = [
+    "FitResult",
+    "fit_distributed",
     "TpuExecutor",
     "WorkerError",
     "WorkerGroup",

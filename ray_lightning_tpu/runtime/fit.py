@@ -1,0 +1,145 @@
+"""Distributed fit: the full state round-trip over the runtime substrate.
+
+Rebuild of the reference's core protocol (reference ray_ddp.py:143-199):
+driver ships the training job to N workers, workers run the fit loop
+jointly, and rank 0's results / trained weights / best_model_path come
+back and are patched into the DRIVER's objects — after `fit_distributed`
+returns, the caller's module object holds trained weights (C5 of SURVEY
+§7.1; reference ray_ddp.py:186-193 `load_state_dict` + best_model_path
+patch-in).
+
+Differences from the reference, by design (SURVEY §7.4 hard parts #1-#3):
+  * the workers are H host-processes jointly executing ONE SPMD program
+    (a global mesh), not N independent replicas — so "shipping the model"
+    means shipping its FACTORY (static module def + config), not a pickled
+    live trainer; array state is created sharded on the mesh.
+  * weights return via a host gather (`process_allgather`) only when small
+    enough (`return_weights`), else as a sharded checkpoint path — never
+    funnel 8B params through a driver pickle (SURVEY §2.4 scaling hazard).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_lightning_tpu.runtime.launch import launch
+from ray_lightning_tpu.utils import get_logger
+
+log = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class FitResult:
+    """What comes back from rank 0 (reference tuple at ray_ddp.py:186 —
+    made a proper type instead of the reference's order-sensitive tuple,
+    whose plugin-dependent ordering was an acknowledged accident,
+    SURVEY §2.4)."""
+
+    metrics: Dict[str, Any]
+    best_model_path: Optional[str]
+    state_dict: Optional[Any]  # host numpy pytree, or None if too large
+    checkpoint_path: Optional[str]
+
+
+def _fit_remote(
+    module_factory: Callable[[], Any],
+    trainer_factory: Callable[[], Any],
+    data_factory: Callable[[], Any],
+    return_weights: bool,
+    final_ckpt_dir: Optional[str],
+):
+    """Runs in EVERY worker process after jax.distributed init (the analog
+    of train_remote, reference ray_ddp.py:217-246)."""
+    import jax
+    import numpy as np
+
+    module = module_factory()
+    trainer = trainer_factory()
+    data = data_factory()
+    if not isinstance(data, tuple):
+        data = (data, None)
+    train_data, val_data = data
+    trainer.fit(module, train_data, val_data)
+
+    rank = jax.process_index()
+    ckpt_path = None
+    if final_ckpt_dir is not None:
+        # Sharded write: every process writes its addressable shards
+        # (orbax handles the coordination); replaces the reference's
+        # driver-side single-file checkpoint.
+        ckpt_path = trainer.save_checkpoint(
+            os.path.join(final_ckpt_dir, "final")
+        )
+    state_dict = None
+    if return_weights:
+        from jax.experimental import multihost_utils
+
+        params = trainer.state.params
+        if jax.process_count() > 1:
+            params = multihost_utils.process_allgather(params, tiled=True)
+        if rank == 0:
+            state_dict = jax.tree.map(np.asarray, jax.device_get(params))
+
+    best = None
+    if trainer.checkpoint_callback is not None:
+        best = trainer.checkpoint_callback.best_model_path
+    if rank == 0:
+        return FitResult(
+            metrics=dict(trainer.callback_metrics),
+            best_model_path=best,
+            state_dict=state_dict,
+            checkpoint_path=ckpt_path,
+        )
+    return None
+
+
+def fit_distributed(
+    module_factory: Callable[[], Any],
+    trainer_factory: Callable[[], Any],
+    data_factory: Callable[[], Any],
+    num_processes: int,
+    *,
+    module: Optional[Any] = None,
+    platform: Optional[str] = None,
+    num_cpu_devices_per_process: Optional[int] = None,
+    env: Optional[Dict[str, str]] = None,
+    init_hook: Optional[Callable[[], None]] = None,
+    on_queue_item: Optional[Callable[[int, Any], None]] = None,
+    return_weights: bool = True,
+    final_ckpt_dir: Optional[str] = None,
+    timeout: Optional[float] = None,
+    log_dir: Optional[str] = None,
+) -> FitResult:
+    """Run a Trainer.fit as one multi-process SPMD job; return rank 0's
+    results and (optionally) patch trained weights into ``module``.
+
+    The three factories are shipped by value (cloudpickle), replacing the
+    reference's "model must be pickleable" contract (README.md:119) with
+    the JAX-friendly split of static definition vs array state
+    (SURVEY §7.4 hard part #3).
+    """
+    results: List[Any] = launch(
+        _fit_remote,
+        num_processes,
+        args=(module_factory, trainer_factory, data_factory,
+              return_weights, final_ckpt_dir),
+        platform=platform,
+        num_cpu_devices_per_process=num_cpu_devices_per_process,
+        env=env,
+        init_hook=init_hook,
+        on_queue_item=on_queue_item,
+        timeout=timeout,
+        log_dir=log_dir,
+    )
+    result = results[0]
+    assert isinstance(result, FitResult), (
+        f"rank 0 returned {type(result)}; expected FitResult"
+    )
+    if module is not None and result.state_dict is not None:
+        # reference ray_ddp.py:190: driver model gets the trained weights,
+        # ready for local inference.
+        if hasattr(module, "setup"):
+            module.setup()
+        module.params = result.state_dict
+    return result

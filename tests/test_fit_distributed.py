@@ -1,0 +1,88 @@
+"""End-to-end distributed fit over the runtime substrate: the rebuild of
+the reference's main path (SURVEY §3.1) — driver ships the job, H
+processes jointly train one SPMD program, rank 0's metrics/weights come
+back, and the driver's module holds trained weights (reference
+ray_ddp.py:178-193)."""
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.runtime import FitResult, fit_distributed
+
+
+def _make_module():
+    from ray_lightning_tpu.models.mlp import MLPClassifier
+
+    return MLPClassifier(features=(32,), num_classes=4, lr=5e-2)
+
+
+def _make_trainer():
+    from ray_lightning_tpu import DataParallel, Trainer
+
+    return Trainer(
+        strategy=DataParallel(),
+        max_epochs=2,
+        enable_progress_bar=False,
+        enable_checkpointing=False,
+        seed=0,
+    )
+
+
+def _make_data():
+    import jax
+
+    from ray_lightning_tpu import DataLoader
+
+    rng = np.random.default_rng(0)
+    centers = rng.normal(size=(4, 8)) * 3
+    y = rng.integers(0, 4, size=256)
+    x = (centers[y] + rng.normal(size=(256, 8)) * 0.1).astype(np.float32)
+    train = DataLoader(
+        {"x": x, "y": y},
+        batch_size=16,
+        shuffle=True,
+        num_shards=jax.process_count(),
+        shard_index=jax.process_index(),
+    )
+    val = DataLoader(
+        {"x": x, "y": y},
+        batch_size=16,
+        num_shards=jax.process_count(),
+        shard_index=jax.process_index(),
+    )
+    return train, val
+
+
+@pytest.mark.slow
+def test_fit_distributed_round_trip(tmp_path):
+    module = _make_module()
+    assert module.params is None
+    result = fit_distributed(
+        _make_module,
+        _make_trainer,
+        _make_data,
+        num_processes=2,
+        module=module,
+        platform="cpu",
+        num_cpu_devices_per_process=2,
+        log_dir=str(tmp_path),
+        timeout=420,
+    )
+    assert isinstance(result, FitResult)
+    # Trained to (near-)perfect separability.
+    assert result.metrics["ptl/val_accuracy"] > 0.9
+    # C5: the DRIVER's module object now holds the trained weights.
+    assert module.params is not None
+    leaves = [np.asarray(l) for l in _tree_leaves(module.params)]
+    assert all(np.isfinite(l).all() for l in leaves)
+    # And they are usable for local inference.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    logits = module.apply(module.params, np.zeros((2, 8), np.float32))
+    assert logits.shape == (2, 4)
+
+
+def _tree_leaves(tree):
+    import jax
+
+    return jax.tree.leaves(tree)
