@@ -1,0 +1,116 @@
+"""Llama-3-style pretraining with FSDP sharding over a device mesh.
+
+This stands where the reference's second-protocol example stood
+(reference examples/ray_horovod_example.py:1-198): the alternative
+distribution strategy demonstrated end-to-end. On TPU the "protocol"
+choice (DDP vs Horovod) becomes a sharding-policy choice (DataParallel vs
+FSDP/ShardedMesh over the same XLA collectives — SURVEY §2.2 Horovod row),
+and the model is the BASELINE.json north-star config (Llama-8B FSDP).
+
+Run:
+    python examples/llama_fsdp_example.py --smoke-test
+    python examples/llama_fsdp_example.py --model 8b --fsdp 64   # v5p-64
+    python examples/llama_fsdp_example.py --model 1b --fsdp 4 --data 2
+"""
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def synthetic_tokens(vocab_size: int, n_seqs: int, seq_len: int, seed=0):
+    """Synthetic corpus (the sandbox downloads nothing); swap in a real
+    tokenized dataset loader in production."""
+    rng = np.random.default_rng(seed)
+    return {"tokens": rng.integers(
+        0, vocab_size, (n_seqs, seq_len + 1)).astype(np.int32)}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", choices=["tiny", "1b", "8b"], default="1b")
+    p.add_argument("--data", type=int, default=1, help="data-parallel degree")
+    p.add_argument("--fsdp", type=int, default=0,
+                   help="fsdp degree (default: all remaining devices)")
+    p.add_argument("--tensor", type=int, default=1, help="tensor-parallel degree")
+    p.add_argument("--seq-len", type=int, default=2048)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--max-steps", type=int, default=50)
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--smoke-test", action="store_true")
+    args = p.parse_args()
+
+    if args.smoke_test:
+        from ray_lightning_tpu.utils import simulate_cpu_devices
+
+        simulate_cpu_devices(4)
+
+    import jax
+
+    from ray_lightning_tpu import (
+        DataLoader,
+        ShardedMesh,
+        ThroughputMonitor,
+        Trainer,
+    )
+    from ray_lightning_tpu.models.llama import LlamaConfig, LlamaModule
+
+    n_dev = len(jax.devices())
+    on_tpu = jax.devices()[0].platform == "tpu"
+
+    if args.smoke_test:
+        cfg = LlamaConfig.tiny(use_flash=on_tpu)
+        args.seq_len = min(args.seq_len, 128)
+        args.batch_size = 4
+        args.max_steps = 4
+    elif args.model == "tiny":
+        cfg = LlamaConfig.tiny(use_flash=on_tpu)
+    elif args.model == "1b":
+        cfg = LlamaConfig(vocab_size=32768, dim=2048, n_layers=16,
+                          n_heads=16, n_kv_heads=8, hidden_dim=5632,
+                          max_seq_len=args.seq_len, use_flash=on_tpu)
+    else:
+        cfg = LlamaConfig.llama3_8b(use_flash=on_tpu,
+                                    max_seq_len=args.seq_len)
+
+    fsdp = args.fsdp or max(1, n_dev // (args.data * args.tensor))
+    strategy = ShardedMesh(data=args.data, fsdp=fsdp, tensor=args.tensor)
+
+    seq_len = min(args.seq_len, cfg.max_seq_len)
+    module = LlamaModule(cfg, lr=args.lr,
+                         warmup_steps=min(10, max(1, args.max_steps // 2)),
+                         total_steps=args.max_steps)
+    data = synthetic_tokens(
+        cfg.vocab_size,
+        n_seqs=max(64, 4 * args.batch_size),
+        seq_len=seq_len,
+    )
+    trainer = Trainer(
+        strategy=strategy,
+        max_epochs=10_000,           # bounded by max_steps
+        max_steps=args.max_steps,
+        callbacks=[ThroughputMonitor()],
+        precision="bf16" if on_tpu else "f32",
+        enable_checkpointing=not args.smoke_test,
+        enable_progress_bar=True,
+        log_every_n_steps=5,
+        default_root_dir=os.path.join(os.getcwd(), "llama_fsdp"),
+    )
+    trainer.fit(module, DataLoader(data, batch_size=args.batch_size,
+                                   shuffle=True, drop_last=True))
+
+    m = trainer.callback_metrics
+    tok_s = args.batch_size * seq_len / m["step_time_s"]
+    print(f"mesh={dict(strategy.mesh.shape)} "
+          f"loss={float(m['loss']):.4f} "
+          f"step_time={float(m['step_time_s'])*1e3:.1f}ms "
+          f"tokens/sec={tok_s:,.0f}")
+
+
+if __name__ == "__main__":
+    main()

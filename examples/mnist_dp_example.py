@@ -1,0 +1,183 @@
+"""MNIST end-to-end with the DataParallel strategy — plain train + sweep.
+
+Parity target: reference examples/ray_ddp_example.py:1-168 (MNIST training
+under RayPlugin, optional Tune sweep, --smoke-test CI mode). TPU-first
+differences: the "workers" are mesh devices (XLA SPMD data parallelism),
+not Ray actors; the sweep reserves integral device groups instead of
+extra_cpu oversubscription (reference :107-112).
+
+Run:
+    python examples/mnist_dp_example.py --smoke-test
+    python examples/mnist_dp_example.py --num-workers 8 --max-epochs 5
+    python examples/mnist_dp_example.py --tune --num-samples 4
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import tempfile
+
+import numpy as np
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_mnist(smoke: bool = False):
+    """Real MNIST via torchvision when available; a separable synthetic
+    stand-in otherwise (the sandbox has no downloads — the reference's
+    examples used an init_hook + FileLock for the same per-node download
+    problem, reference ray_ddp_tune.py:22-25,40)."""
+    try:
+        from torchvision.datasets import MNIST  # noqa: PLC0415
+
+        root = os.path.join(tempfile.gettempdir(), "mnist")
+        train = MNIST(root, train=True, download=True)
+        x = (train.data.numpy().astype(np.float32) / 255.0).reshape(-1, 784)
+        y = train.targets.numpy().astype(np.int32)
+    except Exception:
+        rng = np.random.default_rng(0)
+        n = 2048 if smoke else 16384
+        y = rng.integers(0, 10, size=n).astype(np.int32)
+        centers = rng.standard_normal((10, 784)).astype(np.float32) * 2.0
+        x = centers[y] + rng.standard_normal((n, 784)).astype(np.float32)
+    if smoke:
+        x, y = x[:2048], y[:2048]
+    split = int(0.9 * len(x))
+    return ({"x": x[:split], "y": y[:split]},
+            {"x": x[split:], "y": y[split:]})
+
+
+def make_module(config):
+    import flax.linen as nn
+    import optax
+
+    from ray_lightning_tpu import TpuModule
+
+    class _MLP(nn.Module):
+        hidden1: int
+        hidden2: int
+
+        @nn.compact
+        def __call__(self, x):
+            x = nn.relu(nn.Dense(self.hidden1)(x))
+            x = nn.relu(nn.Dense(self.hidden2)(x))
+            return nn.Dense(10)(x)
+
+    class MNISTClassifier(TpuModule):
+        def __init__(self, lr, hidden1, hidden2):
+            super().__init__()
+            self.save_hyperparameters(lr=lr, hidden1=hidden1, hidden2=hidden2)
+            self.lr, self.h1, self.h2 = lr, hidden1, hidden2
+
+        def configure_model(self):
+            return _MLP(self.h1, self.h2)
+
+        def configure_optimizers(self):
+            return optax.adam(self.lr)
+
+        def training_step(self, params, batch, rng):
+            logits = self.apply(params, batch["x"])
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, batch["y"]).mean()
+            self.log("ptl/train_loss", loss)
+            return loss
+
+        def validation_step(self, params, batch):
+            logits = self.apply(params, batch["x"])
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, batch["y"]).mean()
+            acc = (logits.argmax(-1) == batch["y"]).mean()
+            return {"ptl/val_loss": loss, "ptl/val_accuracy": acc}
+
+    return MNISTClassifier(config["lr"], config["hidden1"], config["hidden2"])
+
+
+def train_mnist(config, num_workers, max_epochs, smoke, callbacks=None,
+                root_dir=None):
+    from ray_lightning_tpu import DataLoader, DataParallel, Trainer
+
+    train, val = load_mnist(smoke)
+    module = make_module(config)
+    trainer = Trainer(
+        strategy=DataParallel(num_workers=num_workers),
+        max_epochs=max_epochs,
+        limit_train_batches=8 if smoke else None,
+        callbacks=callbacks,
+        default_root_dir=root_dir or os.path.join(os.getcwd(), "mnist_dp"),
+        enable_progress_bar=False,
+        log_every_n_steps=10,
+    )
+    trainer.fit(
+        module,
+        DataLoader(train, batch_size=config["batch_size"], shuffle=True,
+                   drop_last=True),
+        DataLoader(val, batch_size=config["batch_size"], drop_last=True),
+    )
+    acc = trainer.callback_metrics.get("ptl/val_accuracy")
+    print(f"final val accuracy: {float(acc):.4f}")
+    return trainer
+
+
+def tune_mnist(num_workers, num_samples, max_epochs, smoke):
+    """Sweep analog of the reference's tune_mnist
+    (reference examples/ray_ddp_example.py:79-116)."""
+    from ray_lightning_tpu import sweep
+
+    def trainable(config):
+        train_mnist(
+            config, num_workers, max_epochs, smoke,
+            callbacks=[sweep.TuneReportCallback(
+                metrics={"loss": "ptl/val_loss", "acc": "ptl/val_accuracy"})],
+            root_dir=sweep.get_trial_dir(),
+        )
+
+    analysis = sweep.run(
+        trainable,
+        config={
+            "lr": sweep.loguniform(1e-4, 1e-1),
+            "hidden1": sweep.choice([64, 128]),
+            "hidden2": sweep.choice([128, 256]),
+            "batch_size": sweep.choice([64, 128]),
+        },
+        num_samples=num_samples,
+        metric="loss",
+        mode="min",
+        executor="inline" if smoke else "process",
+        resources_per_trial=sweep.TpuResources(chips=num_workers),
+        name="tune_mnist",
+    )
+    print("Best hyperparameters:", analysis.best_config)
+    return analysis
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-workers", type=int, default=None,
+                   help="devices in the data-parallel mesh (default: all)")
+    p.add_argument("--max-epochs", type=int, default=3)
+    p.add_argument("--tune", action="store_true", help="run the HPO sweep")
+    p.add_argument("--num-samples", type=int, default=4)
+    p.add_argument("--smoke-test", action="store_true")
+    args = p.parse_args()
+
+    if args.smoke_test:
+        # CI mode (reference :152-158): tiny run on a virtual CPU mesh.
+        from ray_lightning_tpu.utils import simulate_cpu_devices
+
+        simulate_cpu_devices(2)
+        args.num_workers = args.num_workers or 2
+        args.max_epochs = 1
+
+    if args.tune:
+        tune_mnist(args.num_workers or 1, args.num_samples,
+                   args.max_epochs, args.smoke_test)
+    else:
+        config = {"lr": 1e-3, "hidden1": 128, "hidden2": 256,
+                  "batch_size": 128}
+        train_mnist(config, args.num_workers, args.max_epochs,
+                    args.smoke_test)
+
+
+if __name__ == "__main__":
+    main()

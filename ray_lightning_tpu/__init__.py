@@ -36,6 +36,7 @@ from ray_lightning_tpu.runtime import (
     launch_cpu_spmd,
 )
 from ray_lightning_tpu.utils import seed_everything, simulate_cpu_devices
+from ray_lightning_tpu import sweep
 
 __version__ = "0.1.0"
 
@@ -64,5 +65,6 @@ __all__ = [
     "launch_cpu_spmd",
     "seed_everything",
     "simulate_cpu_devices",
+    "sweep",
     "__version__",
 ]

@@ -1,0 +1,67 @@
+"""HPO sweep layer — the rebuild of the reference's Ray Tune integration
+(reference tune.py + examples/ray_ddp_tune.py; SURVEY §3.3, §7.2 L5').
+
+Surface:
+    analysis = sweep.run(trainable, config={...}, num_samples=8,
+                         metric="val_loss", mode="min",
+                         scheduler=sweep.ASHAScheduler(),
+                         resources_per_trial=sweep.TpuResources(chips=4))
+    analysis.best_config / analysis.best_checkpoint
+
+Inside a trainable: ``sweep.report(loss=...)`` directly, or attach
+``TuneReportCallback`` / ``TuneReportCheckpointCallback`` to the Trainer.
+"""
+from ray_lightning_tpu.sweep.analysis import ExperimentAnalysis, Trial
+from ray_lightning_tpu.sweep.callbacks import (
+    TuneReportCallback,
+    TuneReportCheckpointCallback,
+)
+from ray_lightning_tpu.sweep.resources import ResourcePool, TpuResources
+from ray_lightning_tpu.sweep.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    TrialScheduler,
+)
+from ray_lightning_tpu.sweep.session import (
+    TrialStopped,
+    get_trial_dir,
+    get_trial_id,
+    is_trial_session_enabled,
+    report,
+)
+from ray_lightning_tpu.sweep.space import (
+    choice,
+    expand,
+    grid_search,
+    loguniform,
+    randint,
+    uniform,
+)
+from ray_lightning_tpu.sweep.tuner import SweepError, run
+
+__all__ = [
+    "run",
+    "SweepError",
+    "ExperimentAnalysis",
+    "Trial",
+    "TuneReportCallback",
+    "TuneReportCheckpointCallback",
+    "TpuResources",
+    "ResourcePool",
+    "TrialScheduler",
+    "FIFOScheduler",
+    "ASHAScheduler",
+    "MedianStoppingRule",
+    "report",
+    "get_trial_id",
+    "get_trial_dir",
+    "is_trial_session_enabled",
+    "TrialStopped",
+    "choice",
+    "uniform",
+    "loguniform",
+    "randint",
+    "grid_search",
+    "expand",
+]

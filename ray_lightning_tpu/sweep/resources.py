@@ -1,0 +1,72 @@
+"""TPU resource accounting for concurrent trials.
+
+SURVEY §7.4 hard part #4: the reference reserved training-worker capacity
+with Ray Tune's ``extra_cpu``/``extra_gpu`` oversubscription trick
+(reference examples/ray_ddp_example.py:107-112) — the trial actor occupies
+1 CPU and *reserves* N more for the workers it will launch. That trick has
+no TPU analog: a trial must own an **integral device group** (a slice /
+host group) because ICI collectives span the whole group. So the sweep
+layer does the accounting itself: a ``ResourcePool`` of total chips, each
+trial acquiring an integral ``TpuResources`` block, concurrency =
+floor(total / per-trial) — reserve-don't-occupy, enforced by the trial
+runner rather than by a cluster scheduler.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class TpuResources:
+    """What ONE trial reserves.
+
+    chips  — devices the trial's mesh will span (its workers occupy them).
+    hosts  — host processes the trial will launch (driver-side bookkeeping
+             only; on CI these are subprocesses, on a pod they are per-host
+             runtimes).
+    """
+
+    chips: int = 1
+    hosts: int = 1
+
+    def __post_init__(self):
+        if self.chips < 1 or self.hosts < 1:
+            raise ValueError(f"resources must be >= 1, got {self}")
+
+
+class ResourcePool:
+    """Thread-safe integral-block allocator over a fixed chip budget."""
+
+    def __init__(self, total_chips: int):
+        if total_chips < 1:
+            raise ValueError("total_chips must be >= 1")
+        self.total_chips = total_chips
+        self._in_use = 0
+        self._lock = threading.Lock()
+
+    def max_concurrent(self, per_trial: TpuResources) -> int:
+        """floor(topology / per-trial shape) — SURVEY §7.4 #4."""
+        return self.total_chips // per_trial.chips
+
+    def try_acquire(self, res: TpuResources) -> bool:
+        with self._lock:
+            if res.chips > self.total_chips:
+                raise ValueError(
+                    f"trial wants {res.chips} chips but the pool only has "
+                    f"{self.total_chips} — an integral slice cannot be "
+                    "oversubscribed"
+                )
+            if self._in_use + res.chips > self.total_chips:
+                return False
+            self._in_use += res.chips
+            return True
+
+    def release(self, res: TpuResources) -> None:
+        with self._lock:
+            self._in_use = max(0, self._in_use - res.chips)
+
+    @property
+    def in_use(self) -> int:
+        with self._lock:
+            return self._in_use

@@ -1,0 +1,414 @@
+"""The sweep runner: S concurrent trials, each a driver of its own workers.
+
+Rebuild of the reference's signature three-level topology (SURVEY §3.3):
+Tune driver -> trial actors -> training-worker actors, where each trial
+runs the ENTIRE distributed-fit stack inside itself (reference
+examples/ray_ddp_example.py:101-113; tests/test_tune.py). Here:
+
+  sweep driver (this module)
+    -> trial processes       (one runtime worker process per trial,
+                              process-isolated like a Ray trial actor)
+      -> training workers    (the trial calls Trainer.fit directly, or
+                              fit_distributed to launch its own SPMD
+                              worker group — the nested case)
+
+Differences by design:
+  * resource accounting is integral-slice (resources.py), not the
+    reference's extra_cpu oversubscription trick (SURVEY §7.4 #4);
+  * the report channel is duplex — the scheduler's verdict returns on the
+    same socket and a stopped trial unwinds cooperatively via
+    TrialStopped (schedulers.py), instead of Tune killing the actor;
+  * checkpoints never transit the channel — trials write them in place
+    and report paths (SURVEY §2.4 scaling hazard, consciously fixed).
+"""
+from __future__ import annotations
+
+import os
+import secrets
+import threading
+import traceback
+from collections import deque
+from multiprocessing.connection import Listener
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_lightning_tpu.runtime.group import WorkerGroup, WorkerError
+from ray_lightning_tpu.sweep import session as trial_session
+from ray_lightning_tpu.sweep.analysis import ExperimentAnalysis, Trial
+from ray_lightning_tpu.sweep.resources import ResourcePool, TpuResources
+from ray_lightning_tpu.sweep.schedulers import (
+    CONTINUE,
+    FIFOScheduler,
+    TrialScheduler,
+)
+from ray_lightning_tpu.sweep.space import expand
+from ray_lightning_tpu.utils import get_logger
+
+log = get_logger(__name__)
+
+
+class SweepError(RuntimeError):
+    pass
+
+
+def _probe_device_count(executor: str) -> int:
+    """Default chip-pool size.
+
+    With process-isolated trials the DRIVER must not initialize the
+    accelerator backend (on TPU, libtpu is exclusively held by whichever
+    process touches it first — the driver grabbing it would starve every
+    trial's workers), so the topology is probed in a throwaway subprocess.
+    Inline trials run in this process and will initialize jax anyway.
+    """
+    if executor == "inline":
+        import jax
+
+        return len(jax.devices())
+    import subprocess
+    import sys
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+            capture_output=True, text=True, timeout=120,
+        )
+        return int(out.stdout.strip().splitlines()[-1])
+    except Exception:  # noqa: BLE001 — fall back to a safe minimum
+        log.warning("device-count probe failed; defaulting the pool to 1 "
+                    "chip — pass total_chips explicitly")
+        return 1
+
+
+def _trial_main(trainable, config, trial_id, trial_dir, address, authkey_hex):
+    """Body of one trial — runs inside the trial's own worker process
+    (the analog of the reference's trial-actor trainable,
+    reference examples/ray_ddp_example.py:61-76)."""
+    ctx = trial_session.RemoteTrialContext(
+        trial_id, trial_dir, address, bytes.fromhex(authkey_hex)
+    )
+    trial_session.init_trial_session(ctx)
+    # Nested SPMD workers launched by this trial inherit the trial identity
+    # through the environment (sweep/callbacks.py resolves trial_dir from it
+    # when the trial session object itself isn't bound in the worker).
+    os.environ["RLT_TRIAL_ID"] = trial_id
+    os.environ["RLT_TRIAL_DIR"] = trial_dir
+    try:
+        result = trainable(config)
+        return (Trial.DONE, result)
+    except trial_session.TrialStopped:
+        return (Trial.STOPPED, None)
+    finally:
+        ctx.close()
+        trial_session.reset_trial_session()
+
+
+class _ReportServer:
+    """Driver-side end of the duplex report channel: accepts one socket
+    per trial, answers every report with the scheduler's verdict."""
+
+    def __init__(self, handle_report: Callable[[str, Dict, Optional[str]], str]):
+        self._handle = handle_report
+        self._authkey = secrets.token_bytes(32)
+        self._listener = Listener(("127.0.0.1", 0), authkey=self._authkey)
+        self._closed = False
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True
+        )
+        self._accept_thread.start()
+
+    @property
+    def address(self) -> tuple:
+        return self._listener.address
+
+    @property
+    def authkey_hex(self) -> str:
+        return self._authkey.hex()
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            except Exception:  # noqa: BLE001 — e.g. AuthenticationError from
+                # a stray/malformed connection must not kill the acceptor;
+                # later trials still need to hand-shake.
+                if self._closed:
+                    return
+                log.warning("report server: rejected connection\n%s",
+                            traceback.format_exc(limit=2))
+                continue
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            ).start()
+
+    def _serve(self, conn) -> None:
+        try:
+            while True:
+                msg = conn.recv()
+                if msg[0] == "report":
+                    _, trial_id, metrics, ckpt = msg
+                    # A handler error must still produce a reply — the trial
+                    # is blocked on recv() and would hang forever otherwise.
+                    try:
+                        verdict = self._handle(trial_id, metrics, ckpt)
+                    except Exception:  # noqa: BLE001
+                        log.error("report handler failed for %s:\n%s",
+                                  trial_id, traceback.format_exc())
+                        verdict = CONTINUE
+                    conn.send(verdict)
+                elif msg[0] in ("hello", "bye"):
+                    if msg[0] == "bye":
+                        return
+                else:
+                    log.warning("report server: unknown message %r", msg[0])
+        except (EOFError, OSError):
+            return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._closed = True
+        self._listener.close()
+
+
+class TrialRunner:
+    def __init__(
+        self,
+        trainable: Callable[[Dict[str, Any]], Any],
+        configs: List[Dict[str, Any]],
+        *,
+        metric: Optional[str],
+        mode: str,
+        scheduler: TrialScheduler,
+        resources_per_trial: TpuResources,
+        pool: ResourcePool,
+        max_concurrent: Optional[int],
+        storage_dir: str,
+        executor: str,
+        trial_timeout: Optional[float],
+        env: Optional[Dict[str, str]],
+    ):
+        self.trainable = trainable
+        self.metric = metric
+        self.mode = mode
+        self.scheduler = scheduler
+        self.resources = resources_per_trial
+        self.pool = pool
+        self.storage_dir = storage_dir
+        self.executor = executor
+        self.trial_timeout = trial_timeout
+        self.env = env
+        cap = pool.max_concurrent(resources_per_trial)
+        if cap < 1:
+            raise SweepError(
+                f"one trial needs {resources_per_trial.chips} chips but the "
+                f"pool has {pool.total_chips}"
+            )
+        self.max_concurrent = min(max_concurrent or cap, cap)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.trials: List[Trial] = []
+        for i, cfg in enumerate(configs):
+            tid = f"trial_{i:05d}"
+            tdir = os.path.join(storage_dir, tid)
+            os.makedirs(tdir, exist_ok=True)
+            self.trials.append(Trial(tid, cfg, tdir, resources_per_trial))
+        self._by_id = {t.trial_id: t for t in self.trials}
+
+    # ------------------------------------------------------------- reports
+    def _handle_report(self, trial_id: str, metrics: Dict[str, Any],
+                       checkpoint: Optional[str]) -> str:
+        with self._lock:
+            trial = self._by_id.get(trial_id)
+            if trial is None:
+                log.warning("report from unknown trial %s", trial_id)
+                return CONTINUE
+            iteration = trial.iterations + 1
+            record = dict(metrics)
+            # Ray Tune parity: every report carries training_iteration
+            # (asserted by the reference's tests, test_tune.py:44-45).
+            record.setdefault("training_iteration", iteration)
+            trial.history.append(record)
+            trial.last_result = record
+            if checkpoint:
+                trial.checkpoints.append(checkpoint)
+            key = self.scheduler.metric or self.metric
+            value = record.get(key) if key else None
+            try:
+                value = float(value) if value is not None else None
+            except (TypeError, ValueError):
+                value = None  # non-numeric metric: scheduler sees no signal
+            verdict = self.scheduler.on_result(trial_id, iteration, value)
+            if verdict != CONTINUE:
+                log.info("scheduler stopping %s at iteration %d", trial_id,
+                         iteration)
+            return verdict
+
+    # -------------------------------------------------------------- inline
+    def _run_inline(self) -> None:
+        for trial in self.trials:
+            trial.status = Trial.RUNNING
+            ctx = trial_session.LocalTrialContext(
+                trial.trial_id, trial.trial_dir, self._handle_report
+            )
+            trial_session.init_trial_session(ctx)
+            saved_env = {k: os.environ.get(k)
+                         for k in ("RLT_TRIAL_ID", "RLT_TRIAL_DIR")}
+            os.environ["RLT_TRIAL_ID"] = trial.trial_id
+            os.environ["RLT_TRIAL_DIR"] = trial.trial_dir
+            try:
+                trial.result = self.trainable(trial.config)
+                trial.status = Trial.DONE
+            except trial_session.TrialStopped:
+                trial.status = Trial.STOPPED
+            except BaseException as exc:  # noqa: BLE001 — recorded per trial
+                trial.status = Trial.ERROR
+                trial.error = traceback.format_exc()
+                log.error("trial %s failed: %s", trial.trial_id, exc)
+            finally:
+                trial_session.reset_trial_session()
+                for k, v in saved_env.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+                self.scheduler.on_trial_complete(trial.trial_id)
+
+    # ------------------------------------------------------------- process
+    def _run_process(self) -> None:
+        server = _ReportServer(self._handle_report)
+        pending = deque(self.trials)
+        running: set = set()
+        try:
+            with self._cond:
+                while pending or running:
+                    while (pending and len(running) < self.max_concurrent
+                           and self.pool.try_acquire(self.resources)):
+                        trial = pending.popleft()
+                        running.add(trial.trial_id)
+                        trial.status = Trial.RUNNING
+                        threading.Thread(
+                            target=self._trial_thread,
+                            args=(trial, server, running),
+                            daemon=True,
+                        ).start()
+                    self._cond.wait(timeout=1.0)
+        finally:
+            server.close()
+
+    def _trial_thread(self, trial: Trial, server: _ReportServer,
+                      running: set) -> None:
+        group = WorkerGroup(
+            num_workers=1,
+            env={**(self.env or {}),
+                 "RLT_TRIAL_ID": trial.trial_id,
+                 "RLT_TRIAL_DIR": trial.trial_dir},
+            log_dir=os.path.join(trial.trial_dir, "logs"),
+        )
+        try:
+            group.start()
+            [out] = group.run(
+                _trial_main,
+                per_rank_args=[(self.trainable, trial.config, trial.trial_id,
+                                trial.trial_dir, server.address,
+                                server.authkey_hex)],
+                timeout=self.trial_timeout,
+            )
+            trial.status, trial.result = out
+        except WorkerError as exc:
+            trial.status = Trial.ERROR
+            trial.error = exc.traceback_str
+            log.error("trial %s failed:\n%s", trial.trial_id,
+                      exc.traceback_str)
+        except BaseException:  # noqa: BLE001 — recorded per trial
+            trial.status = Trial.ERROR
+            trial.error = traceback.format_exc()
+            log.error("trial %s infra failure:\n%s", trial.trial_id,
+                      trial.error)
+        finally:
+            group.shutdown()
+            self.pool.release(self.resources)
+            self.scheduler.on_trial_complete(trial.trial_id)
+            with self._cond:
+                running.discard(trial.trial_id)
+                self._cond.notify_all()
+
+    # ----------------------------------------------------------------- run
+    def run(self) -> List[Trial]:
+        if self.executor == "inline":
+            self._run_inline()
+        elif self.executor == "process":
+            self._run_process()
+        else:
+            raise ValueError(f"unknown executor {self.executor!r}")
+        return self.trials
+
+
+def run(
+    trainable: Callable[[Dict[str, Any]], Any],
+    config: Optional[Dict[str, Any]] = None,
+    *,
+    num_samples: int = 1,
+    metric: Optional[str] = None,
+    mode: str = "min",
+    scheduler: Optional[TrialScheduler] = None,
+    resources_per_trial: Optional[TpuResources] = None,
+    total_chips: Optional[int] = None,
+    max_concurrent: Optional[int] = None,
+    storage_dir: Optional[str] = None,
+    name: str = "sweep",
+    executor: str = "process",
+    trial_timeout: Optional[float] = None,
+    env: Optional[Dict[str, str]] = None,
+    seed: int = 0,
+    raise_on_failed_trial: bool = True,
+) -> ExperimentAnalysis:
+    """``tune.run`` analog (reference examples/ray_ddp_example.py:101-113).
+
+    ``trainable(config)`` runs once per trial; inside it, ``sweep.report``
+    (directly or via the TuneReportCallback family) streams metrics back.
+    ``executor="process"`` gives Ray-Tune-style per-trial process isolation
+    (each trial may itself launch an SPMD worker group); ``"inline"`` runs
+    trials sequentially in this process (debug / single-host).
+
+    ``total_chips`` is the pool the reserve-don't-occupy accounting carves
+    integral per-trial blocks out of; it defaults to the number of visible
+    devices (one v5p slice on a pod, the virtual CPU mesh in tests).
+    """
+    if mode not in ("min", "max"):
+        raise ValueError("mode must be 'min' or 'max'")
+    configs = expand(config or {}, num_samples=num_samples, seed=seed)
+    if not configs:
+        raise ValueError("empty search space")
+    scheduler = scheduler or FIFOScheduler()
+    if scheduler.metric is None:
+        scheduler.metric = metric
+        scheduler.mode = mode
+    resources_per_trial = resources_per_trial or TpuResources()
+    if total_chips is None:
+        total_chips = max(_probe_device_count(executor),
+                          resources_per_trial.chips)
+    pool = ResourcePool(total_chips)
+    storage_dir = storage_dir or os.path.join(os.getcwd(), "rlt_sweeps", name)
+    os.makedirs(storage_dir, exist_ok=True)
+
+    runner = TrialRunner(
+        trainable, configs,
+        metric=metric, mode=mode, scheduler=scheduler,
+        resources_per_trial=resources_per_trial, pool=pool,
+        max_concurrent=max_concurrent, storage_dir=storage_dir,
+        executor=executor, trial_timeout=trial_timeout, env=env,
+    )
+    log.info("sweep %s: %d trials, <=%d concurrent, %d chips/trial of %d",
+             name, len(runner.trials), runner.max_concurrent,
+             resources_per_trial.chips, total_chips)
+    trials = runner.run()
+    analysis = ExperimentAnalysis(trials, metric, mode)
+    failed = analysis.errors()
+    if failed and raise_on_failed_trial:
+        detail = "\n".join(f"--- {k} ---\n{v}" for k, v in failed.items())
+        raise SweepError(f"{len(failed)} trial(s) failed:\n{detail}")
+    return analysis

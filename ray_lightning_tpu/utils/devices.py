@@ -6,15 +6,26 @@ long as no backend has initialized yet)."""
 from __future__ import annotations
 
 import os
+import re
 
 
 def simulate_cpu_devices(n: int = 8) -> None:
-    """Emulate an n-device mesh on host CPU for tests/laptops/CI."""
+    """Emulate an n-device mesh on host CPU for tests/laptops/CI.
+
+    Authoritative about the count: an inherited
+    ``--xla_force_host_platform_device_count`` (e.g. leaked from an outer
+    test harness into a subprocess) is replaced, not kept — callers asking
+    for n devices get n.
+    """
     flags = os.environ.get("XLA_FLAGS", "")
-    if "--xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={n}"
-        ).strip()
+    want = f"--xla_force_host_platform_device_count={n}"
+    if "--xla_force_host_platform_device_count" in flags:
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", want, flags
+        )
+    else:
+        flags = f"{flags} {want}"
+    os.environ["XLA_FLAGS"] = flags.strip()
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 

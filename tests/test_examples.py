@@ -1,0 +1,58 @@
+"""Examples as smoke tests — the reference ran every example with
+--smoke-test as a dedicated CI job (reference
+.github/workflows/test.yaml:70-77, examples/ray_ddp_example.py:144-158);
+same mechanism here, in subprocesses so each example controls its own
+JAX platform config."""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+
+
+def _run(script: str, *args: str, cwd: str = EXAMPLES) -> str:
+    env = dict(os.environ)
+    # examples pick their own platform/device-count in --smoke-test mode;
+    # don't leak the harness's (conftest sets an 8-device XLA_FLAGS)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, script), "--smoke-test",
+         *args],
+        capture_output=True, text=True, timeout=420, env=env, cwd=cwd,
+    )
+    assert out.returncode == 0, (
+        f"{script} failed:\n--- stdout ---\n{out.stdout[-3000:]}"
+        f"\n--- stderr ---\n{out.stderr[-3000:]}"
+    )
+    return out.stdout
+
+
+@pytest.mark.slow
+def test_mnist_dp_example(tmp_path):
+    out = _run("mnist_dp_example.py", cwd=str(tmp_path))
+    assert "final val accuracy" in out
+
+
+@pytest.mark.slow
+def test_mnist_dp_example_tune(tmp_path):
+    out = _run("mnist_dp_example.py", "--tune", "--num-samples", "2",
+               cwd=str(tmp_path))
+    assert "Best hyperparameters" in out
+
+
+@pytest.mark.slow
+def test_mnist_sweep_example(tmp_path):
+    out = _run("mnist_sweep_example.py", cwd=str(tmp_path))
+    assert "Best checkpoint" in out
+
+
+@pytest.mark.slow
+def test_llama_fsdp_example(tmp_path):
+    out = _run("llama_fsdp_example.py", cwd=str(tmp_path))
+    assert "tokens/sec" in out

@@ -1,0 +1,305 @@
+"""Sweep/HPO tests — parity with the reference's Tune suite
+(reference tests/test_tune.py): nested HPO correctness (iterations ==
+sampled max_epochs, :34-45), best_checkpoint exists (:60-74), plus the
+rebuild's own surface: search spaces, integral resource accounting, ASHA
+early stopping, process-isolated trials, and the nested
+sweep-over-distributed-fit topology (SURVEY §3.3)."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from ray_lightning_tpu import DataLoader, SingleDevice, sweep
+from ray_lightning_tpu.sweep.analysis import Trial
+
+from tests.utils import BoringModel, get_trainer, random_dataset
+
+
+# ---------------------------------------------------------------- spaces
+
+
+def test_space_expand_grid_and_samplers():
+    space = {
+        "lr": sweep.loguniform(1e-4, 1e-1),
+        "bs": sweep.grid_search([16, 32]),
+        "layers": sweep.grid_search([1, 2, 3]),
+        "fixed": "adam",
+    }
+    configs = sweep.expand(space, num_samples=2, seed=0)
+    assert len(configs) == 2 * 2 * 3
+    assert {c["bs"] for c in configs} == {16, 32}
+    assert all(1e-4 <= c["lr"] <= 1e-1 for c in configs)
+    assert all(c["fixed"] == "adam" for c in configs)
+    # deterministic under the same seed
+    assert configs == sweep.expand(space, num_samples=2, seed=0)
+
+
+def test_space_choice_randint():
+    space = {"a": sweep.choice([1, 2, 3]), "b": sweep.randint(0, 10),
+             "c": sweep.uniform(0.0, 1.0)}
+    configs = sweep.expand(space, num_samples=20, seed=1)
+    assert len(configs) == 20
+    assert all(c["a"] in (1, 2, 3) for c in configs)
+    assert all(0 <= c["b"] < 10 for c in configs)
+
+
+# -------------------------------------------------------------- resources
+
+
+def test_resource_pool_integral_blocks():
+    pool = sweep.ResourcePool(total_chips=8)
+    per_trial = sweep.TpuResources(chips=4)
+    assert pool.max_concurrent(per_trial) == 2
+    assert pool.try_acquire(per_trial)
+    assert pool.try_acquire(per_trial)
+    assert not pool.try_acquire(per_trial)  # 8/8 in use
+    pool.release(per_trial)
+    assert pool.try_acquire(per_trial)
+    with pytest.raises(ValueError):
+        pool.try_acquire(sweep.TpuResources(chips=16))  # > slice
+
+
+# ------------------------------------------------- inline trials + ASHA
+
+
+def _fake_trainable(config):
+    """Pure-python trainable: loss is config-determined, 12 iterations."""
+    for _ in range(12):
+        sweep.report(loss=float(config["q"]))
+    return "done"
+
+
+def test_fifo_runs_all_trials_to_completion(tmp_path):
+    analysis = sweep.run(
+        _fake_trainable,
+        config={"q": sweep.grid_search([0.1, 0.5, 0.9])},
+        metric="loss",
+        mode="min",
+        executor="inline",
+        total_chips=8,
+        storage_dir=str(tmp_path),
+    )
+    assert all(t.status == Trial.DONE for t in analysis.trials)
+    assert all(t.iterations == 12 for t in analysis.trials)
+    assert analysis.best_config == {"q": 0.1}
+    assert analysis.best_trial.last_result["training_iteration"] == 12
+
+
+def test_asha_stops_bad_trials_early(tmp_path):
+    analysis = sweep.run(
+        _fake_trainable,
+        config={"q": sweep.grid_search([0.1, 0.2, 0.8, 0.9])},
+        metric="loss",
+        mode="min",
+        scheduler=sweep.ASHAScheduler(grace_period=1, reduction_factor=2,
+                                      max_t=12),
+        executor="inline",
+        total_chips=8,
+        storage_dir=str(tmp_path),
+    )
+    by_q = {t.config["q"]: t for t in analysis.trials}
+    assert by_q[0.1].status == Trial.DONE  # the best survives
+    stopped = [t for t in analysis.trials if t.status == Trial.STOPPED]
+    assert stopped, "ASHA stopped nothing"
+    assert all(t.iterations < 12 for t in stopped)
+    assert analysis.best_config == {"q": 0.1}
+
+
+def test_median_stopping_rule():
+    rule = sweep.MedianStoppingRule(metric="loss", mode="min",
+                                    grace_period=2, min_samples=2)
+    # two good peers establish the median
+    for step in range(1, 6):
+        assert rule.on_result("good_a", step, 0.1) == "continue"
+        assert rule.on_result("good_b", step, 0.2) == "continue"
+    # a clearly-worse trial gets cut after grace
+    assert rule.on_result("bad", 1, 5.0) == "continue"  # grace
+    assert rule.on_result("bad", 2, 5.0) == "stop"
+
+
+def test_trial_error_recorded_and_raised(tmp_path):
+    def boom(config):
+        if config["x"] == 1:
+            raise RuntimeError("kaboom")
+        sweep.report(loss=1.0)
+
+    analysis = sweep.run(
+        boom, config={"x": sweep.grid_search([0, 1])},
+        metric="loss", executor="inline", total_chips=8,
+        storage_dir=str(tmp_path / "a"), raise_on_failed_trial=False,
+    )
+    statuses = {t.config["x"]: t.status for t in analysis.trials}
+    assert statuses == {0: Trial.DONE, 1: Trial.ERROR}
+    assert "kaboom" in analysis.errors()["trial_00001"]
+
+    with pytest.raises(sweep.SweepError, match="kaboom"):
+        sweep.run(
+            boom, config={"x": sweep.grid_search([0, 1])},
+            metric="loss", executor="inline", total_chips=8,
+            storage_dir=str(tmp_path / "b"),
+        )
+
+
+# ------------------------------------- trainer-in-the-loop (ref parity)
+
+
+def _trainer_trainable(root_dir, with_checkpoint=False):
+    data = random_dataset(n=128)
+
+    def trainable(config):
+        cb_cls = (sweep.TuneReportCheckpointCallback if with_checkpoint
+                  else sweep.TuneReportCallback)
+        cb = cb_cls(metrics={"loss": "val_loss", "acc": "val_acc"})
+        module = BoringModel(lr=config["lr"])
+        trainer = get_trainer(
+            os.path.join(root_dir, sweep.get_trial_id()),
+            strategy=SingleDevice(),
+            max_epochs=config["max_epochs"],
+            callbacks=[cb],
+            checkpoint_callback=False,
+        )
+        train = DataLoader(data, batch_size=32)
+        val = DataLoader(data, batch_size=32)
+        trainer.fit(module, train, val)
+
+    return trainable
+
+
+def test_sweep_iterations_match_max_epochs(tmp_path):
+    """Reference parity: trial iteration count == sampled max_epochs
+    (reference tests/test_tune.py:34-45)."""
+    analysis = sweep.run(
+        _trainer_trainable(str(tmp_path)),
+        config={"lr": 1e-2, "max_epochs": sweep.grid_search([1, 2])},
+        metric="loss",
+        mode="min",
+        executor="inline",
+        total_chips=8,
+        storage_dir=str(tmp_path / "sweep"),
+    )
+    for t in analysis.trials:
+        assert t.status == Trial.DONE
+        assert t.last_result["training_iteration"] == t.config["max_epochs"]
+        assert "loss" in t.last_result and "acc" in t.last_result
+
+
+def test_sweep_best_checkpoint_exists(tmp_path):
+    """Reference parity: analysis.best_checkpoint exists and is loadable
+    (reference tests/test_tune.py:60-74) — but as an in-place sharded
+    checkpoint path, not a queue-shipped dict (SURVEY §2.4)."""
+    from ray_lightning_tpu.checkpoint.io import read_meta
+
+    analysis = sweep.run(
+        _trainer_trainable(str(tmp_path), with_checkpoint=True),
+        config={"lr": sweep.grid_search([1e-2, 1e-1]), "max_epochs": 2},
+        metric="loss",
+        mode="min",
+        executor="inline",
+        total_chips=8,
+        storage_dir=str(tmp_path / "sweep"),
+    )
+    best = analysis.best_checkpoint
+    assert best and os.path.exists(best)
+    meta = read_meta(best)
+    assert meta["global_step"] > 0
+    # every trial registered one checkpoint per epoch
+    assert all(len(t.checkpoints) == 2 for t in analysis.trials)
+
+
+# --------------------------------------- process-isolated trial actors
+
+
+def test_process_trials_and_concurrency(tmp_path):
+    """Trials run in their own processes (the reference's trial-actor
+    isolation) with integral-chip accounting capping concurrency."""
+    analysis = sweep.run(
+        _fake_trainable,
+        config={"q": sweep.grid_search([0.3, 0.6, 0.9])},
+        metric="loss",
+        mode="min",
+        executor="process",
+        total_chips=8,
+        resources_per_trial=sweep.TpuResources(chips=4),  # => 2 concurrent
+        storage_dir=str(tmp_path),
+        trial_timeout=120.0,
+    )
+    assert all(t.status == Trial.DONE for t in analysis.trials)
+    assert all(t.iterations == 12 for t in analysis.trials)
+    assert analysis.best_config == {"q": 0.3}
+    # per-trial process isolation leaves per-trial logs behind
+    for t in analysis.trials:
+        assert os.path.isdir(os.path.join(t.trial_dir, "logs"))
+
+
+def test_process_trial_failure_is_fail_fast(tmp_path):
+    def boom(config):
+        raise ValueError("process kaboom")
+
+    analysis = sweep.run(
+        boom, config={}, metric="loss", executor="process",
+        total_chips=8, storage_dir=str(tmp_path),
+        raise_on_failed_trial=False, trial_timeout=120.0,
+    )
+    [t] = analysis.trials
+    assert t.status == Trial.ERROR
+    assert "process kaboom" in t.error
+
+
+# ------------------------------ nested: sweep over distributed SPMD fit
+
+
+@pytest.mark.slow
+def test_sweep_over_fit_distributed(tmp_path):
+    """The signature three-level topology (SURVEY §3.3): sweep driver →
+    trial → SPMD worker group. Worker rank 0's report closure trampolines
+    through the runtime queue into the trial session (reference
+    tune.py:97-101 + util.py:88-93 rebuilt)."""
+    from ray_lightning_tpu.runtime import fit_distributed
+
+    root = str(tmp_path)
+
+    def trainable(config):
+        def module_factory():
+            return BoringModel(lr=config["lr"])
+
+        def trainer_factory():
+            from ray_lightning_tpu import DataParallel
+
+            return get_trainer(
+                os.path.join(root, "inner"),
+                strategy=DataParallel(),
+                max_epochs=config["max_epochs"],
+                callbacks=[sweep.TuneReportCallback(
+                    metrics={"loss": "val_loss"})],
+                checkpoint_callback=False,
+            )
+
+        def data_factory():
+            data = random_dataset(n=128)
+            return (DataLoader(data, batch_size=32),
+                    DataLoader(data, batch_size=32))
+
+        fit_distributed(
+            module_factory, trainer_factory, data_factory,
+            num_processes=2, platform="cpu",
+            num_cpu_devices_per_process=2,
+            return_weights=False,
+            log_dir=os.path.join(root, "workers"),
+        )
+
+    analysis = sweep.run(
+        trainable,
+        config={"lr": 1e-2, "max_epochs": 2},
+        metric="loss",
+        mode="min",
+        executor="inline",
+        total_chips=8,
+        resources_per_trial=sweep.TpuResources(chips=4),
+        storage_dir=os.path.join(root, "sweep"),
+    )
+    [t] = analysis.trials
+    assert t.status == Trial.DONE
+    assert t.last_result["training_iteration"] == 2
+    assert t.last_result["loss"] > 0
