@@ -51,6 +51,7 @@ class TpuModule:
         self.model = None          # flax module, set by configure_model()
         self.params: Any = None    # trained weights land here after fit (C5)
         self.trainer = None        # backref set by Trainer during fit
+        self.mesh = None           # bound by Strategy.setup before setup()
         self.hparams: Dict[str, Any] = {}
         self._logged: Dict[str, jnp.ndarray] = {}
 
@@ -123,6 +124,13 @@ class TpuModule:
     def pop_logged(self) -> Dict[str, jnp.ndarray]:
         out, self._logged = self._logged, {}
         return out
+
+    def num_params(self) -> int:
+        assert self.params is not None, "no params; fit or init first"
+        import numpy as np
+
+        return sum(int(np.prod(p.shape))
+                   for p in jax.tree.leaves(self.params))
 
     def save_hyperparameters(self, **kwargs) -> None:
         """Record ctor kwargs for `load_from_checkpoint` reconstruction.

@@ -132,6 +132,8 @@ class Trainer:
         self._base_rng = jax.random.key(seed)
         self.module = module
         module.trainer = self
+        # mesh first: configure_model may close over it (ring attention).
+        self.strategy.setup(module)
         module.setup()
 
         if datamodule is not None:
@@ -141,8 +143,6 @@ class Trainer:
         if train_dataloaders is None:
             raise ValueError("fit() needs train_dataloaders or a datamodule")
         self.has_validation = val_dataloaders is not None
-
-        self.strategy.setup(module)
         example_batch, train_dataloaders = self._peek(train_dataloaders)
 
         self.tx = self._build_tx(module)
@@ -312,9 +312,14 @@ class Trainer:
             raise ValueError("no module; pass one or fit first")
         self.module = module
         module.trainer = self
-        module.setup()
         if self.strategy.mesh is None:
             self.strategy.setup(module)
+        else:
+            # mesh already built (e.g. validate(moduleB) after
+            # fit(moduleA)): rebind so param_specs/mesh come from the
+            # module actually being run.
+            self.strategy.bind_module(module)
+        module.setup()
         return module
 
     def _ensure_state(self, module: TpuModule, loader) -> None:

@@ -31,6 +31,7 @@ from jax.sharding import PartitionSpec as P
 
 from ray_lightning_tpu.core.module import TpuModule
 from ray_lightning_tpu.ops.attention import flash_attention
+from ray_lightning_tpu.ops.ring_attention import ring_attention
 from ray_lightning_tpu.ops.norms import rms_norm
 from ray_lightning_tpu.ops.rope import apply_rope, rope_frequencies
 
@@ -51,6 +52,11 @@ class LlamaConfig:
     remat: bool = True
     scan_layers: bool = True
     use_flash: bool = True
+    #: shard attention over the mesh's `seq` axis (ring attention,
+    #: ops/ring_attention.py) — long-context training where one device
+    #: cannot hold the full sequence's KV. Takes effect when the strategy's
+    #: mesh has seq > 1.
+    seq_parallel: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -72,6 +78,7 @@ class LlamaConfig:
 
 class LlamaBlock(nn.Module):
     cfg: LlamaConfig
+    mesh: Optional[Any] = None  # jax.sharding.Mesh (static, hashable)
 
     @nn.compact
     def __call__(self, x, cos, sin):
@@ -93,10 +100,16 @@ class LlamaBlock(nn.Module):
         v = v.reshape(B, S, n_kv, hd)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        # use_flash=True -> auto (pallas on TPU, XLA fallback elsewhere);
-        # use_flash=False -> always the XLA reference path.
-        attn = flash_attention(q, k, v, causal=True,
-                               use_pallas=None if cfg.use_flash else False)
+        if (cfg.seq_parallel and self.mesh is not None
+                and self.mesh.shape.get("seq", 1) > 1):
+            # manual island: sequence sharded over `seq`, KV blocks rotate
+            # the ring; everything outside stays compiler-sharded.
+            attn = ring_attention(q, k, v, self.mesh, causal=True)
+        else:
+            # use_flash=True -> auto (pallas on TPU, XLA fallback
+            # elsewhere); use_flash=False -> always the XLA reference path.
+            attn = flash_attention(q, k, v, causal=True,
+                                   use_pallas=None if cfg.use_flash else False)
         attn = attn.reshape(B, S, n_q * hd)
         x = x + dense(d, name="wo")(attn)
 
@@ -113,6 +126,7 @@ class Llama(nn.Module):
     """Flax core model: token ids [B, S] -> logits [B, S, V]."""
 
     cfg: LlamaConfig
+    mesh: Optional[Any] = None  # set by the strategy for seq/tensor islands
 
     @nn.compact
     def __call__(self, tokens: jnp.ndarray) -> jnp.ndarray:
@@ -141,10 +155,10 @@ class Llama(nn.Module):
                 length=cfg.n_layers,
                 in_axes=nn.broadcast,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-            )(cfg, name="layers")(x, cos, sin)
+            )(cfg, self.mesh, name="layers")(x, cos, sin)
         else:
             for i in range(cfg.n_layers):
-                x, _ = block(cfg, name=f"layer_{i}")(x, cos, sin)
+                x, _ = block(cfg, self.mesh, name=f"layer_{i}")(x, cos, sin)
 
         final_w = self.param("final_norm", nn.initializers.ones, (cfg.dim,))
         x = rms_norm(x, final_w, cfg.norm_eps)
@@ -234,7 +248,9 @@ class LlamaModule(TpuModule):
         )
 
     def configure_model(self):
-        return Llama(self.cfg)
+        # `self.mesh` is bound by Strategy.setup before the model builds,
+        # so seq/tensor manual islands (ring attention) see the live mesh.
+        return Llama(self.cfg, mesh=self.mesh)
 
     def configure_optimizers(self):
         sched = optax.warmup_cosine_decay_schedule(
@@ -273,6 +289,3 @@ class LlamaModule(TpuModule):
         inputs, _, _ = self._split(batch)
         return self.model.init(rng, inputs)["params"]
 
-    def num_params(self) -> int:
-        assert self.params is not None
-        return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(self.params))

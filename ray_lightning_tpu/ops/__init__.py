@@ -12,12 +12,18 @@ from ray_lightning_tpu.ops.attention import (
     make_causal_mask,
 )
 from ray_lightning_tpu.ops.norms import rms_norm
+from ray_lightning_tpu.ops.ring_attention import (
+    ring_attention,
+    ring_attention_local,
+)
 from ray_lightning_tpu.ops.rope import apply_rope, rope_frequencies
 
 __all__ = [
     "dot_product_attention",
     "flash_attention",
     "make_causal_mask",
+    "ring_attention",
+    "ring_attention_local",
     "rms_norm",
     "apply_rope",
     "rope_frequencies",

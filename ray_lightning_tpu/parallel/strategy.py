@@ -85,6 +85,10 @@ class Strategy:
         self.spec = self.build_spec(len(devices))
         self.mesh = self.spec.build(devices)
         self._module = module
+        if module is not None:
+            # bind before the module builds its model so seq/tensor manual
+            # islands (e.g. ring attention) can close over the mesh.
+            module.mesh = self.mesh
         log.info(
             "strategy=%s mesh=%s over %d %s device(s)",
             type(self).__name__,
@@ -93,6 +97,13 @@ class Strategy:
             devices[0].platform,
         )
         return self.mesh
+
+    def bind_module(self, module) -> None:
+        """Point an already-built mesh at a (new) module: its param_specs
+        drive sharding and it sees the mesh before building its model."""
+        self._module = module
+        if module is not None:
+            module.mesh = self.mesh
 
     def teardown(self) -> None:
         self.mesh = None

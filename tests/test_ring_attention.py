@@ -1,0 +1,121 @@
+"""Ring attention / sequence parallelism tests.
+
+Exactness: ring attention over a seq-sharded mesh must match full
+attention to float tolerance (it is the same math, blockwise). Then the
+full stack: a Llama with seq_parallel=True on a (data, seq, tensor) mesh
+produces the same logits as the unsharded model with identical params,
+and trains end-to-end.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_lightning_tpu import DataLoader, ShardedMesh, Trainer, make_mesh
+from ray_lightning_tpu.ops import dot_product_attention, ring_attention
+
+
+def _qkv(B=2, S=32, H=4, Hkv=None, D=8, dtype=jnp.float32, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    Hkv = Hkv or H
+    q = jax.random.normal(ks[0], (B, S, H, D), dtype)
+    k = jax.random.normal(ks[1], (B, S, Hkv, D), dtype)
+    v = jax.random.normal(ks[2], (B, S, Hkv, D), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("seq", [2, 4, 8])
+def test_ring_matches_full_attention(devices8, causal, seq):
+    mesh = make_mesh(seq=seq, devices=devices8[:seq])
+    q, k, v = _qkv()
+    ref = dot_product_attention(q, k, v, causal=causal)
+    out = ring_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_gqa_and_mixed_mesh(devices8):
+    """GQA (kv heads < q heads) on a full data×seq×tensor mesh."""
+    mesh = make_mesh(data=2, seq=2, tensor=2, devices=devices8)
+    q, k, v = _qkv(B=4, S=16, H=4, Hkv=2, D=8)
+    ref = dot_product_attention(q, k, v, causal=True)
+    out = ring_attention(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_under_jit(devices8):
+    """The manual island composes with an outer jit (the Trainer's shape)."""
+    mesh = make_mesh(seq=4, devices=devices8[:4])
+    q, k, v = _qkv(S=16)
+
+    @jax.jit
+    def f(q, k, v):
+        return ring_attention(q, k, v, mesh, causal=True)
+
+    np.testing.assert_allclose(
+        np.asarray(f(q, k, v)),
+        np.asarray(dot_product_attention(q, k, v, causal=True)),
+        atol=2e-5, rtol=2e-5,
+    )
+
+
+# ------------------------------------------------ llama integration
+
+
+def _llama_logits(cfg, params, tokens, mesh=None):
+    from ray_lightning_tpu.models.llama import Llama
+
+    model = Llama(cfg, mesh=mesh)
+    return model.apply({"params": params}, tokens)
+
+
+def test_llama_seq_parallel_matches_dense(devices8):
+    """Same params, same tokens: the ring path must reproduce the plain
+    attention path's logits."""
+    import dataclasses
+
+    from ray_lightning_tpu.models.llama import Llama, LlamaConfig
+
+    cfg = LlamaConfig.tiny(use_flash=False, dtype=jnp.float32)
+    tokens = np.asarray(
+        jax.random.randint(jax.random.key(0), (2, 32), 0, cfg.vocab_size),
+        dtype=np.int32,
+    )
+    params = jax.jit(Llama(cfg).init)(jax.random.key(1), tokens)["params"]
+    ref = _llama_logits(cfg, params, tokens)
+
+    mesh = make_mesh(data=2, seq=4, devices=devices8)
+    sp_cfg = dataclasses.replace(cfg, seq_parallel=True)
+    out = _llama_logits(sp_cfg, params, tokens, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_llama_trains_with_seq_parallel(devices8, tmp_path):
+    """Full training step over a data×seq mesh: strategy binds the mesh,
+    configure_model builds the ring path, loss decreases machinery runs."""
+    from ray_lightning_tpu.models.llama import LlamaConfig, LlamaModule
+
+    cfg = LlamaConfig.tiny(use_flash=False, seq_parallel=True)
+    module = LlamaModule(cfg, lr=1e-3, warmup_steps=1, total_steps=4)
+    rng = np.random.default_rng(0)
+    data = {"tokens": rng.integers(
+        0, cfg.vocab_size, (16, 33)).astype(np.int32)}
+
+    trainer = Trainer(
+        strategy=ShardedMesh(data=2, seq=4, devices=devices8,
+                             min_shard_size=1),
+        max_epochs=1,
+        limit_train_batches=2,
+        default_root_dir=str(tmp_path),
+        enable_checkpointing=False,
+        enable_progress_bar=False,
+    )
+    trainer.fit(module, DataLoader(data, batch_size=8))
+    assert trainer.global_step == 2
+    assert np.isfinite(float(trainer.callback_metrics["loss"]))
+    assert module.model.mesh is not None  # the ring path was built
