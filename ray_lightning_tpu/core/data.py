@@ -33,6 +33,7 @@ class DataLoader:
         drop_last: bool = True,
         num_shards: int = 1,
         shard_index: int = 0,
+        prefetch: bool = False,
     ):
         self.data = data
         self.batch_size = batch_size
@@ -41,6 +42,8 @@ class DataLoader:
         self.drop_last = drop_last
         self.num_shards = num_shards
         self.shard_index = shard_index
+        self.prefetch = prefetch
+        self._batcher = None
         self._epoch = 0
         self._stream = callable(data)
         if self._stream:
@@ -81,12 +84,42 @@ class DataLoader:
             shard = idx[self.shard_index * per : (self.shard_index + 1) * per]
         else:
             shard = idx
+        if self.prefetch and (batcher := self._get_batcher()) is not None:
+            # native path: worker threads assemble batches ahead of the
+            # loop (ray_lightning_tpu/native/batcher.cpp); same order,
+            # same shapes as the numpy path below.
+            batcher.set_epoch(shard)
+            yield from batcher
+            self._epoch += 1
+            return
         n = len(shard)
         stop = n - n % self.batch_size if self.drop_last else n
         for start in range(0, stop, self.batch_size):
             take = shard[start : start + self.batch_size]
             yield _tree_take(self.data, take)
         self._epoch += 1
+
+    def _get_batcher(self):
+        """Lazily build the native prefetcher; None when ineligible (non-
+        dict pytrees, non-numpy leaves) or the toolchain is unavailable."""
+        if self._batcher is not None:
+            return self._batcher
+        if not isinstance(self.data, dict) or not all(
+            isinstance(v, np.ndarray)
+            and (np.issubdtype(v.dtype, np.number) or v.dtype == np.bool_)
+            for v in self.data.values()
+        ):
+            return None  # object/string leaves can't cross the C ABI
+        try:
+            from ray_lightning_tpu.native import NativeBatcher
+
+            self._batcher = NativeBatcher(
+                self.data, self.batch_size, drop_last=self.drop_last,
+            )
+        except (RuntimeError, ValueError):
+            self.prefetch = False  # don't retry every epoch
+            return None
+        return self._batcher
 
 
 class DataModule:

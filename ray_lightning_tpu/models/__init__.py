@@ -5,18 +5,40 @@ The reference ships only test MLPs and an MNIST example
 BASELINE.json configs additionally require ResNet/CIFAR, BERT fine-tune,
 and Llama-3-8B FSDP — all provided here as TpuModules.
 """
+from ray_lightning_tpu.models.bert import (
+    BertClassifierModule,
+    BertConfig,
+    BertEncoder,
+    BertForSequenceClassification,
+)
 from ray_lightning_tpu.models.llama import (
     Llama,
     LlamaConfig,
     LlamaModule,
 )
 from ray_lightning_tpu.models.mlp import MLP, MLPClassifier, MNISTClassifier
+from ray_lightning_tpu.models.resnet import (
+    ResNet,
+    ResNetModule,
+    resnet18,
+    resnet34,
+    resnet50,
+)
 
 __all__ = [
+    "BertClassifierModule",
+    "BertConfig",
+    "BertEncoder",
+    "BertForSequenceClassification",
     "Llama",
     "LlamaConfig",
     "LlamaModule",
     "MLP",
     "MLPClassifier",
     "MNISTClassifier",
+    "ResNet",
+    "ResNetModule",
+    "resnet18",
+    "resnet34",
+    "resnet50",
 ]

