@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Lint/format entry point (reference analog: format.sh with yapf+flake8,
+# reference format.sh:1-140). One tool here: ruff handles both roles.
+#
+#   ./format.sh           # fix in place
+#   ./format.sh --check   # CI mode: fail on violations
+set -euo pipefail
+cd "$(dirname "$0")"
+
+RUFF_ARGS=(check ray_lightning_tpu tests examples bench.py __graft_entry__.py)
+
+if [[ "${1:-}" == "--check" ]]; then
+    ruff "${RUFF_ARGS[@]}"
+else
+    ruff "${RUFF_ARGS[@]}" --fix
+fi

@@ -19,6 +19,7 @@ tests/utils.py:96-120) — this is net-new capability designed for the MXU:
 from __future__ import annotations
 
 import dataclasses
+import functools
 from functools import partial
 from typing import Any, Dict, Optional
 
@@ -30,7 +31,10 @@ import optax
 from jax.sharding import PartitionSpec as P
 
 from ray_lightning_tpu.core.module import TpuModule
-from ray_lightning_tpu.ops.attention import flash_attention
+from ray_lightning_tpu.ops.attention import (
+    dot_product_attention,
+    flash_attention,
+)
 from ray_lightning_tpu.ops.ring_attention import ring_attention
 from ray_lightning_tpu.ops.norms import rms_norm
 from ray_lightning_tpu.ops.rope import apply_rope, rope_frequencies
@@ -81,7 +85,11 @@ class LlamaBlock(nn.Module):
     mesh: Optional[Any] = None  # jax.sharding.Mesh (static, hashable)
 
     @nn.compact
-    def __call__(self, x, cos, sin):
+    def __call__(self, x, cos, sin, cache=None, pos=None):
+        """Training/prefill-from-zero when cache is None; with a
+        ``cache=(k_cache, v_cache)`` ([B, S_max, Hkv, hd] each) and a
+        (traced) ``pos``, runs the KV-cache decode path and returns the
+        updated cache as the scan output."""
         cfg = self.cfg
         d, hd = cfg.dim, cfg.head_dim
         dense = partial(nn.Dense, use_bias=False, dtype=cfg.dtype,
@@ -98,18 +106,45 @@ class LlamaBlock(nn.Module):
         q = q.reshape(B, S, n_q, hd)
         k = k.reshape(B, S, n_kv, hd)
         v = v.reshape(B, S, n_kv, hd)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
-        if (cfg.seq_parallel and self.mesh is not None
-                and self.mesh.shape.get("seq", 1) > 1):
-            # manual island: sequence sharded over `seq`, KV blocks rotate
-            # the ring; everything outside stays compiler-sharded.
-            attn = ring_attention(q, k, v, self.mesh, causal=True)
+        if cache is None:
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            if (cfg.seq_parallel and self.mesh is not None
+                    and self.mesh.shape.get("seq", 1) > 1):
+                # manual island: sequence sharded over `seq`, KV blocks
+                # rotate the ring; everything else compiler-sharded.
+                attn = ring_attention(q, k, v, self.mesh, causal=True)
+            else:
+                # use_flash=True -> auto (pallas on TPU, XLA fallback
+                # elsewhere); False -> always the XLA reference path.
+                attn = flash_attention(
+                    q, k, v, causal=True,
+                    use_pallas=None if cfg.use_flash else False)
+            new_cache = None
         else:
-            # use_flash=True -> auto (pallas on TPU, XLA fallback
-            # elsewhere); use_flash=False -> always the XLA reference path.
-            attn = flash_attention(q, k, v, causal=True,
-                                   use_pallas=None if cfg.use_flash else False)
+            positions = pos + jnp.arange(S)
+            q = apply_rope(q, cos, sin, positions=positions)
+            k = apply_rope(k, cos, sin, positions=positions)
+            ck, cv = cache  # [B, S_max, Hkv, hd]
+            ck = jax.lax.dynamic_update_slice_in_dim(
+                ck, k.astype(ck.dtype), pos, axis=1)
+            cv = jax.lax.dynamic_update_slice_in_dim(
+                cv, v.astype(cv.dtype), pos, axis=1)
+            if S > 1 and isinstance(pos, int) and pos == 0:
+                # prefill from empty context: plain causal attention over
+                # the chunk itself (flash path — never materialize the
+                # [S, S_max] masked score matrix against the zero tail).
+                attn = flash_attention(
+                    q, k, v, causal=True,
+                    use_pallas=None if cfg.use_flash else False)
+            else:
+                # single-token decode (or mid-sequence chunk): masked
+                # reference SDPA over the cache — S is tiny here.
+                kv_pos = jnp.arange(ck.shape[1])[None, None, None, :]
+                q_pos = (pos + jnp.arange(S))[None, None, :, None]
+                attn = dot_product_attention(
+                    q, ck, cv, causal=False, mask=kv_pos <= q_pos)
+            new_cache = (ck, cv)
         attn = attn.reshape(B, S, n_q * hd)
         x = x + dense(d, name="wo")(attn)
 
@@ -119,7 +154,7 @@ class LlamaBlock(nn.Module):
         gate_up = dense(2 * cfg.hidden_dim, name="w_gate_up")(h)
         gate, up = jnp.split(gate_up, 2, axis=-1)
         x = x + dense(d, name="w_down")(nn.silu(gate) * up)
-        return x, None  # (carry, out) pair so nn.scan can drive the block
+        return x, new_cache  # (carry, ys) pair so nn.scan drives the block
 
 
 class Llama(nn.Module):
@@ -129,7 +164,15 @@ class Llama(nn.Module):
     mesh: Optional[Any] = None  # set by the strategy for seq/tensor islands
 
     @nn.compact
-    def __call__(self, tokens: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, tokens: jnp.ndarray, cache=None, pos=None,
+                 last_only: bool = False):
+        """Training/eval: ``model(tokens) -> logits``. Decoding:
+        ``model(tokens, cache=(k, v), pos=p) -> (logits, new_cache)``
+        with cache leaves stacked over layers ([L, B, S_max, Hkv, hd];
+        see `init_cache`) and ``p`` the write offset (python 0 for a
+        fresh prefill, traced thereafter). ``last_only`` projects only
+        the final position through the lm_head (prefill wants one row of
+        logits, not [S, vocab])."""
         cfg = self.cfg
         embed = nn.Embed(
             cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
@@ -139,28 +182,50 @@ class Llama(nn.Module):
         cos, sin = rope_frequencies(
             cfg.head_dim, cfg.max_seq_len, cfg.rope_theta, dtype=jnp.float32
         )
-        cos, sin = cos[: tokens.shape[1]], sin[: tokens.shape[1]]
+        if cache is None:
+            cos, sin = cos[: tokens.shape[1]], sin[: tokens.shape[1]]
 
         block = LlamaBlock
-        if cfg.remat:
+        if cfg.remat and cache is None:
             block = nn.remat(
                 block, policy=jax.checkpoint_policies.nothing_saveable
             )
+        new_cache = None
         if cfg.scan_layers:
             # one compiled block, scanned over a stacked-params layer axis
-            x, _ = nn.scan(
-                block,
+            scan = partial(
+                nn.scan,
                 variable_axes={"params": 0},
                 split_rngs={"params": True},
                 length=cfg.n_layers,
-                in_axes=nn.broadcast,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-            )(cfg, self.mesh, name="layers")(x, cos, sin)
+            )
+            if cache is None:
+                x, _ = scan(block, in_axes=nn.broadcast)(
+                    cfg, self.mesh, name="layers")(x, cos, sin)
+            else:
+                # cache rides the scan: in over the layer axis, updated
+                # cache collected as the scan output (out_axes=0).
+                x, new_cache = scan(
+                    block,
+                    in_axes=(nn.broadcast, nn.broadcast, 0, nn.broadcast),
+                    out_axes=0,
+                )(cfg, self.mesh, name="layers")(x, cos, sin, cache, pos)
         else:
+            caches = []
             for i in range(cfg.n_layers):
-                x, _ = block(cfg, self.mesh, name=f"layer_{i}")(x, cos, sin)
+                layer_cache = None if cache is None else jax.tree.map(
+                    lambda c, i=i: c[i], cache)
+                x, c = block(cfg, self.mesh, name=f"layer_{i}")(
+                    x, cos, sin, layer_cache, pos)
+                caches.append(c)
+            if cache is not None:
+                new_cache = jax.tree.map(
+                    lambda *cs: jnp.stack(cs, axis=0), *caches)
 
         final_w = self.param("final_norm", nn.initializers.ones, (cfg.dim,))
+        if last_only:
+            x = x[:, -1:, :]
         x = rms_norm(x, final_w, cfg.norm_eps)
         if cfg.tie_embeddings:
             logits = embed.attend(x.astype(jnp.float32))
@@ -169,7 +234,9 @@ class Llama(nn.Module):
                 cfg.vocab_size, use_bias=False, dtype=jnp.float32,
                 param_dtype=jnp.float32, name="lm_head",
             )(x)
-        return logits
+        if cache is None:
+            return logits
+        return logits, new_cache
 
 
 def _stacked(spec: P, stacked: bool) -> P:
@@ -222,6 +289,84 @@ def cross_entropy_loss(
     if mask is not None:
         return (losses * mask).sum() / jnp.maximum(mask.sum(), 1)
     return losses.mean()
+
+
+def init_cache(cfg: LlamaConfig, batch: int, max_len: int):
+    """Zeroed KV cache, leaves [n_layers, B, max_len, Hkv, head_dim]
+    (layer axis matches the scan's in/out axes)."""
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return (jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+
+
+@functools.lru_cache(maxsize=32)
+def _compiled_generate(model: Llama, B: int, S0: int, max_new_tokens: int,
+                       temperature: float, top_k: Optional[int]):
+    """Build-and-jit once per (model, shape, sampling) key so repeated
+    generate() calls hit XLA's compile cache instead of retracing a
+    fresh closure every time."""
+    cfg = model.cfg
+    max_len = S0 + max_new_tokens
+
+    def sample(logits, rng):
+        if temperature == 0.0:
+            return logits.argmax(-1).astype(jnp.int32)
+        logits = logits / temperature
+        if top_k is not None:
+            kth = jax.lax.top_k(logits, top_k)[0][:, -1][:, None]
+            logits = jnp.where(logits >= kth, logits, -jnp.inf)
+        return jax.random.categorical(rng, logits).astype(jnp.int32)
+
+    def run(params, prompt, rng):
+        cache = init_cache(cfg, B, max_len)
+        logits, cache = model.apply({"params": params}, prompt,
+                                    cache=cache, pos=0, last_only=True)
+        last = logits[:, -1, :]
+        out = jnp.zeros((B, max_new_tokens), jnp.int32)
+
+        def body(t, carry):
+            last, cache, out, rng = carry
+            rng, sub = jax.random.split(rng)
+            tok = sample(last, sub)
+            out = jax.lax.dynamic_update_slice_in_dim(
+                out, tok[:, None], t, axis=1)
+            logits, cache = model.apply({"params": params}, tok[:, None],
+                                        cache=cache, pos=S0 + t)
+            return (logits[:, 0, :], cache, out, rng)
+
+        _, _, out, _ = jax.lax.fori_loop(
+            0, max_new_tokens, body, (last, cache, out, rng))
+        return out
+
+    return jax.jit(run)
+
+
+def generate(
+    model: Llama,
+    params,
+    prompt: jnp.ndarray,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    seed: int = 0,
+) -> jnp.ndarray:
+    """Autoregressive decoding with a KV cache, one compiled program:
+    flash-attention prefill over the prompt (one row of lm_head logits),
+    then a `lax.fori_loop` of single-token steps (each an in-place
+    `dynamic_update_slice` into the cache — static shapes throughout, no
+    per-token recompilation; repeated calls reuse the compiled program).
+
+    Greedy when temperature == 0; otherwise temperature (+ optional
+    top-k) sampling. Returns [B, max_new_tokens] int32.
+    """
+    B, S0 = prompt.shape
+    if S0 + max_new_tokens > model.cfg.max_seq_len:
+        raise ValueError(
+            f"prompt ({S0}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_seq_len ({model.cfg.max_seq_len})"
+        )
+    run = _compiled_generate(model, B, S0, max_new_tokens,
+                             float(temperature), top_k)
+    return run(params, prompt, jax.random.key(seed))
 
 
 class LlamaModule(TpuModule):
@@ -288,4 +433,11 @@ class LlamaModule(TpuModule):
     def init_params(self, rng, batch):
         inputs, _, _ = self._split(batch)
         return self.model.init(rng, inputs)["params"]
+
+    def generate(self, prompt, max_new_tokens: int, **kw) -> jnp.ndarray:
+        """KV-cache autoregressive decoding with the trained params."""
+        assert self.params is not None, "fit or load a checkpoint first"
+        self.setup()
+        return generate(self.model, self.params, jnp.asarray(prompt),
+                        max_new_tokens, **kw)
 
