@@ -11,12 +11,24 @@ from ray_lightning_tpu.models.bert import (
     BertEncoder,
     BertForSequenceClassification,
 )
+from ray_lightning_tpu.models.hf_interop import (
+    bert_classifier_params_from_hf,
+    bert_params_from_hf,
+    llama_params_from_hf,
+)
 from ray_lightning_tpu.models.llama import (
     Llama,
     LlamaConfig,
     LlamaModule,
+    generate,
+    init_cache,
 )
 from ray_lightning_tpu.models.mlp import MLP, MLPClassifier, MNISTClassifier
+from ray_lightning_tpu.models.moe import (
+    MoEClassifierModule,
+    MoEMLP,
+    moe_param_specs,
+)
 from ray_lightning_tpu.models.resnet import (
     ResNet,
     ResNetModule,
@@ -33,9 +45,17 @@ __all__ = [
     "Llama",
     "LlamaConfig",
     "LlamaModule",
+    "bert_classifier_params_from_hf",
+    "bert_params_from_hf",
+    "generate",
+    "init_cache",
+    "llama_params_from_hf",
     "MLP",
     "MLPClassifier",
     "MNISTClassifier",
+    "MoEClassifierModule",
+    "MoEMLP",
+    "moe_param_specs",
     "ResNet",
     "ResNetModule",
     "resnet18",

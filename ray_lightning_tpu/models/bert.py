@@ -81,7 +81,8 @@ class BertLayer(nn.Module):
         attn = nn.Dropout(cfg.dropout)(attn, deterministic=deterministic)
         x = ln(name="attn_ln")(x + attn)
 
-        h = nn.gelu(dense(cfg.hidden_dim, name="w_up")(x))
+        # exact (erf) GELU — what HF BERT checkpoints were trained with
+        h = nn.gelu(dense(cfg.hidden_dim, name="w_up")(x), approximate=False)
         h = dense(cfg.dim, name="w_down")(h)
         h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
         x = ln(name="mlp_ln")(x + h)

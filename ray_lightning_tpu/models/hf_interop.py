@@ -1,0 +1,224 @@
+"""HuggingFace weight interop: map `transformers` state dicts onto the
+framework's flax param trees.
+
+Capability rationale: "fine-tune BERT" (BASELINE config 3) and the Llama
+family only matter in practice if pretrained weights can be loaded. The
+converters are pure name/shape mapping — no transformers dependency at
+runtime beyond the (optional) model you pass in; tensors arrive as numpy
+via `.state_dict()` from the torch-cpu models baked into the image.
+
+Conventions handled:
+  * torch nn.Linear stores [out, in] — transposed to flax's [in, out];
+  * per-layer HF tensors are stacked along the scan axis when the target
+    config uses scan_layers (the framework default);
+  * BERT's separate q/k/v projections are fused into the framework's
+    single wqkv; Llama's separate q/k/v likewise, gate/up into w_gate_up.
+
+Llama RoPE note: the framework rotates [x1, x2] half-split pairs — the
+same "rotate_half" layout HF's LlamaModel uses, so HF checkpoints load
+with no permutation. Meta-native (pre-HF-conversion) weights rotate
+interleaved even/odd pairs and would need the standard q/k_proj
+permutation first; these converters only accept the HF layout.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping
+
+import numpy as np
+
+from ray_lightning_tpu.models.bert import BertConfig
+from ray_lightning_tpu.models.llama import LlamaConfig
+
+
+def _t(x) -> np.ndarray:
+    """torch [out, in] linear weight -> flax [in, out] kernel."""
+    return np.ascontiguousarray(np.asarray(x).T)
+
+
+def _np(x) -> np.ndarray:
+    return np.asarray(x)
+
+
+def _get(sd: Mapping, key: str) -> np.ndarray:
+    if key not in sd:
+        raise KeyError(
+            f"HF state dict is missing {key!r} — wrong architecture or an "
+            f"unexpected transformers version (have e.g. "
+            f"{list(sd)[:3]}...)"
+        )
+    v = sd[key]
+    return np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach") else v)
+
+
+def _stack(per_layer: list) -> Any:
+    """list of per-layer pytrees -> leaves stacked on a leading axis."""
+    import jax
+
+    return jax.tree.map(lambda *xs: np.stack(xs, axis=0), *per_layer)
+
+
+# --------------------------------------------------------------------- bert
+
+
+def bert_params_from_hf(hf_state_dict: Mapping, cfg: BertConfig,
+                        prefix: str = "") -> Dict[str, Any]:
+    """Map a `transformers.BertModel` state dict onto `BertEncoder` params.
+
+    `prefix` handles wrappers ("bert." for BertForSequenceClassification's
+    state dict, "" for a bare BertModel).
+    """
+    sd = hf_state_dict
+    p = prefix
+
+    def emb(name):
+        return _get(sd, f"{p}embeddings.{name}")
+
+    pos_table = emb("position_embeddings.weight")
+    if pos_table.shape[0] < cfg.max_seq_len:
+        raise ValueError(
+            f"cfg.max_seq_len={cfg.max_seq_len} but the checkpoint has "
+            f"only {pos_table.shape[0]} position embeddings — positions "
+            "past the table would silently clamp; lower max_seq_len or "
+            "extend the table explicitly"
+        )
+    encoder: Dict[str, Any] = {
+        "tok_embed": {"embedding": emb("word_embeddings.weight")},
+        "pos_embed": {"embedding": pos_table[: cfg.max_seq_len]},
+        "type_embed": {"embedding": emb("token_type_embeddings.weight")},
+        "embed_ln": {"scale": emb("LayerNorm.weight"),
+                     "bias": emb("LayerNorm.bias")},
+    }
+
+    layers = []
+    for i in range(cfg.n_layers):
+        lp = f"{p}encoder.layer.{i}."
+        q_w = _get(sd, lp + "attention.self.query.weight")
+        k_w = _get(sd, lp + "attention.self.key.weight")
+        v_w = _get(sd, lp + "attention.self.value.weight")
+        q_b = _get(sd, lp + "attention.self.query.bias")
+        k_b = _get(sd, lp + "attention.self.key.bias")
+        v_b = _get(sd, lp + "attention.self.value.bias")
+        layers.append({
+            # fused qkv: concatenate along the OUTPUT dim (flax axis 1)
+            "wqkv": {
+                "kernel": np.concatenate([_t(q_w), _t(k_w), _t(v_w)], 1),
+                "bias": np.concatenate([q_b, k_b, v_b]),
+            },
+            "wo": {
+                "kernel": _t(_get(sd, lp + "attention.output.dense.weight")),
+                "bias": _get(sd, lp + "attention.output.dense.bias"),
+            },
+            "attn_ln": {
+                "scale": _get(sd, lp + "attention.output.LayerNorm.weight"),
+                "bias": _get(sd, lp + "attention.output.LayerNorm.bias"),
+            },
+            "w_up": {
+                "kernel": _t(_get(sd, lp + "intermediate.dense.weight")),
+                "bias": _get(sd, lp + "intermediate.dense.bias"),
+            },
+            "w_down": {
+                "kernel": _t(_get(sd, lp + "output.dense.weight")),
+                "bias": _get(sd, lp + "output.dense.bias"),
+            },
+            "mlp_ln": {
+                "scale": _get(sd, lp + "output.LayerNorm.weight"),
+                "bias": _get(sd, lp + "output.LayerNorm.bias"),
+            },
+        })
+    if cfg.scan_layers:
+        encoder["layers"] = _stack(layers)
+    else:
+        for i, layer in enumerate(layers):
+            encoder[f"layer_{i}"] = layer
+    return encoder
+
+
+def bert_classifier_params_from_hf(hf_state_dict: Mapping,
+                                   cfg: BertConfig,
+                                   num_classes: int,
+                                   rng=None) -> Dict[str, Any]:
+    """Full BertForSequenceClassification tree: pretrained encoder +
+    pooler; classifier head fresh (or from HF when present)."""
+    import jax
+
+    sd = hf_state_dict
+    prefix = "bert." if any(k.startswith("bert.") for k in sd) else ""
+    params: Dict[str, Any] = {
+        "encoder": bert_params_from_hf(sd, cfg, prefix=prefix),
+        "pooler": {
+            "kernel": _t(_get(sd, f"{prefix}pooler.dense.weight")),
+            "bias": _get(sd, f"{prefix}pooler.dense.bias"),
+        },
+    }
+    if "classifier.weight" in sd:
+        params["classifier"] = {"kernel": _t(_get(sd, "classifier.weight")),
+                                "bias": _get(sd, "classifier.bias")}
+    else:
+        rng = rng if rng is not None else jax.random.key(0)
+        params["classifier"] = {
+            "kernel": np.asarray(
+                jax.random.normal(rng, (cfg.dim, num_classes)) * 0.02,
+                dtype=np.float32),
+            "bias": np.zeros((num_classes,), np.float32),
+        }
+    return params
+
+
+# -------------------------------------------------------------------- llama
+
+
+def llama_params_from_hf(hf_state_dict: Mapping,
+                         cfg: LlamaConfig) -> Dict[str, Any]:
+    """Map a `transformers.LlamaForCausalLM` (or LlamaModel) state dict
+    onto the framework's `Llama` params."""
+    sd = hf_state_dict
+    p = "model." if any(k.startswith("model.") for k in sd) else ""
+
+    params: Dict[str, Any] = {
+        "tok_embed": {"embedding": _get(sd, f"{p}embed_tokens.weight")},
+        "final_norm": _get(sd, f"{p}norm.weight"),
+    }
+    if cfg.tie_embeddings:
+        # tied config: embed.attend serves as the lm_head. Guard against
+        # an UNTIED checkpoint (distinct lm_head.weight, e.g. Llama-3)
+        # being silently dropped.
+        if "lm_head.weight" in sd and not np.array_equal(
+            _get(sd, "lm_head.weight"),
+            _np(params["tok_embed"]["embedding"]),
+        ):
+            raise ValueError(
+                "checkpoint has a distinct lm_head.weight but "
+                "cfg.tie_embeddings=True — its output head would be "
+                "discarded; set tie_embeddings=False"
+            )
+    else:
+        lm_key = "lm_head.weight"
+        if lm_key in sd:
+            params["lm_head"] = {"kernel": _t(_get(sd, lm_key))}
+        else:  # tied checkpoints reuse the embedding
+            params["lm_head"] = {
+                "kernel": _np(params["tok_embed"]["embedding"]).T.copy()
+            }
+
+    layers = []
+    for i in range(cfg.n_layers):
+        lp = f"{p}layers.{i}."
+        q = _t(_get(sd, lp + "self_attn.q_proj.weight"))
+        k = _t(_get(sd, lp + "self_attn.k_proj.weight"))
+        v = _t(_get(sd, lp + "self_attn.v_proj.weight"))
+        gate = _t(_get(sd, lp + "mlp.gate_proj.weight"))
+        up = _t(_get(sd, lp + "mlp.up_proj.weight"))
+        layers.append({
+            "wqkv": {"kernel": np.concatenate([q, k, v], axis=1)},
+            "wo": {"kernel": _t(_get(sd, lp + "self_attn.o_proj.weight"))},
+            "w_gate_up": {"kernel": np.concatenate([gate, up], axis=1)},
+            "w_down": {"kernel": _t(_get(sd, lp + "mlp.down_proj.weight"))},
+            "attn_norm": _get(sd, lp + "input_layernorm.weight"),
+            "mlp_norm": _get(sd, lp + "post_attention_layernorm.weight"),
+        })
+    if cfg.scan_layers:
+        params["layers"] = _stack(layers)
+    else:
+        for i, layer in enumerate(layers):
+            params[f"layer_{i}"] = layer
+    return params

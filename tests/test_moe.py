@@ -1,0 +1,78 @@
+"""MoE / expert-parallel tests: routing math, capacity behavior, and
+end-to-end training with the expert mesh axis active."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_lightning_tpu import DataLoader, ShardedMesh, Trainer
+from ray_lightning_tpu.models.moe import MoEClassifierModule, MoEMLP
+
+
+def _apply(layer, x, seed=0):
+    params = layer.init(jax.random.key(seed), x)["params"]
+    return params, layer.apply({"params": params}, x)
+
+
+def test_single_expert_equals_dense_swiglu():
+    """E=1, k=1, ample capacity: the MoE must reduce to one plain SwiGLU
+    FFN — same math, dispatch is the identity."""
+    x = jax.random.normal(jax.random.key(0), (2, 8, 16), jnp.float32)
+    layer = MoEMLP(n_experts=1, hidden_dim=32, top_k=1,
+                   capacity_factor=2.0, dtype=jnp.float32)
+    params, (y, aux) = _apply(layer, x)
+    w_gate_up = params["w_gate_up"][0]
+    w_down = params["w_down"][0]
+    h = x.reshape(-1, 16) @ w_gate_up
+    gate, up = jnp.split(h, 2, axis=-1)
+    ref = (jax.nn.silu(gate) * up) @ w_down
+    np.testing.assert_allclose(np.asarray(y).reshape(-1, 16),
+                               np.asarray(ref), atol=1e-5, rtol=1e-5)
+    assert float(aux) == 1.0  # one expert carries everything
+
+
+def test_combine_weights_and_capacity():
+    x = jax.random.normal(jax.random.key(1), (4, 16, 32), jnp.float32)
+    layer = MoEMLP(n_experts=4, hidden_dim=64, top_k=2,
+                   capacity_factor=1.5, dtype=jnp.float32)
+    _, (y, aux) = _apply(layer, x)
+    assert y.shape == x.shape
+    assert np.isfinite(np.asarray(y)).all()
+    assert 0.5 < float(aux) < 4.0  # load-balance loss is O(1)
+
+    # starving capacity drops tokens but never produces NaNs
+    tight = MoEMLP(n_experts=4, hidden_dim=64, top_k=2,
+                   capacity_factor=0.1, dtype=jnp.float32)
+    _, (y2, _) = _apply(tight, x)
+    assert np.isfinite(np.asarray(y2)).all()
+    # with almost no capacity most outputs are zero (dropped tokens)
+    assert (np.abs(np.asarray(y2)) < 1e-6).mean() > 0.5
+
+
+def test_moe_trains_expert_parallel(devices8, tmp_path):
+    """End-to-end on a data×expert×tensor mesh: the expert axis really
+    shards the stacked expert weights."""
+    rng = np.random.default_rng(0)
+    n, C = 256, 4
+    y = rng.integers(0, C, n).astype(np.int32)
+    centers = rng.standard_normal((C, 32)).astype(np.float32) * 3
+    data = {"x": centers[y] + rng.standard_normal((n, 32)).astype(np.float32),
+            "y": y}
+
+    module = MoEClassifierModule(dim=64, n_experts=4, hidden_dim=128,
+                                 num_classes=C, lr=3e-3)
+    trainer = Trainer(
+        strategy=ShardedMesh(data=2, expert=2, tensor=2,
+                             devices=devices8, min_shard_size=1),
+        max_epochs=6,
+        default_root_dir=str(tmp_path),
+        enable_checkpointing=False, enable_progress_bar=False,
+    )
+    trainer.fit(module, DataLoader(data, batch_size=64, shuffle=True),
+                DataLoader(data, batch_size=64))
+    assert float(trainer.callback_metrics["val_acc"]) >= 0.5
+    # the stacked expert weights are actually sharded over `expert`
+    leaf = trainer.state.params["moe"]["w_gate_up"]
+    spec = leaf.sharding.spec
+    assert "expert" in str(spec), spec
