@@ -36,6 +36,7 @@ from ray_lightning_tpu.ops.attention import (
     flash_attention,
 )
 from ray_lightning_tpu.ops.ring_attention import ring_attention
+from ray_lightning_tpu.ops.ulysses import ulysses_attention
 from ray_lightning_tpu.ops.norms import rms_norm
 from ray_lightning_tpu.ops.rope import apply_rope, rope_frequencies
 
@@ -56,11 +57,21 @@ class LlamaConfig:
     remat: bool = True
     scan_layers: bool = True
     use_flash: bool = True
-    #: shard attention over the mesh's `seq` axis (ring attention,
-    #: ops/ring_attention.py) — long-context training where one device
-    #: cannot hold the full sequence's KV. Takes effect when the strategy's
-    #: mesh has seq > 1.
+    #: shard attention over the mesh's `seq` axis — long-context training
+    #: where one device cannot hold the full sequence's KV. Takes effect
+    #: when the strategy's mesh has seq > 1.
     seq_parallel: bool = False
+    #: "ring" (ppermute KV ring, ops/ring_attention.py — O(S/n) memory,
+    #: any head count) or "ulysses" (head/sequence all_to_all,
+    #: ops/ulysses.py — two collectives, needs heads % seq == 0).
+    seq_parallel_mode: str = "ring"
+
+    def __post_init__(self):
+        if self.seq_parallel_mode not in ("ring", "ulysses"):
+            raise ValueError(
+                f"seq_parallel_mode must be 'ring' or 'ulysses', got "
+                f"{self.seq_parallel_mode!r}"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -111,9 +122,14 @@ class LlamaBlock(nn.Module):
             k = apply_rope(k, cos, sin)
             if (cfg.seq_parallel and self.mesh is not None
                     and self.mesh.shape.get("seq", 1) > 1):
-                # manual island: sequence sharded over `seq`, KV blocks
-                # rotate the ring; everything else compiler-sharded.
-                attn = ring_attention(q, k, v, self.mesh, causal=True)
+                # manual island: sequence sharded over `seq`; everything
+                # else stays compiler-sharded.
+                if cfg.seq_parallel_mode == "ulysses":
+                    attn = ulysses_attention(
+                        q, k, v, self.mesh, causal=True,
+                        use_pallas=None if cfg.use_flash else False)
+                else:
+                    attn = ring_attention(q, k, v, self.mesh, causal=True)
             else:
                 # use_flash=True -> auto (pallas on TPU, XLA fallback
                 # elsewhere); False -> always the XLA reference path.
@@ -230,10 +246,12 @@ class Llama(nn.Module):
         if cfg.tie_embeddings:
             logits = embed.attend(x.astype(jnp.float32))
         else:
+            # vocab projection at activation dtype (bf16 hits the MXU at
+            # full rate; ~3% step-time win); loss math upcasts to f32.
             logits = nn.Dense(
-                cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                 param_dtype=jnp.float32, name="lm_head",
-            )(x)
+            )(x).astype(jnp.float32)
         if cache is None:
             return logits
         return logits, new_cache

@@ -36,6 +36,15 @@ from jax.sharding import PartitionSpec as P
 from ray_lightning_tpu.core.module import TpuModule
 
 
+def _fit_group(total: int, target: int) -> int:
+    """Largest divisor of `total` that is <= target (halving search from
+    target, then linear fallback — totals are products of small powers)."""
+    g = min(total, target)
+    while g > 1 and total % g != 0:
+        g -= 1
+    return max(1, g)
+
+
 class MoEMLP(nn.Module):
     """Top-k routed SwiGLU expert FFN bank: [B, S, D] -> ([B, S, D], aux)."""
 
@@ -45,35 +54,45 @@ class MoEMLP(nn.Module):
     capacity_factor: float = 1.25
     dtype: Any = jnp.bfloat16
 
+    #: capacity groups (GShard §3.2): dispatch/combine tensors are
+    #: [n_groups, group, E, C] with C ~ group*cf*k/E, so their memory is
+    #: O(tokens * group * cf * k) — LINEAR in the token count. Without
+    #: grouping C grows with the whole batch and the one-hots are
+    #: O(tokens^2). Groups also bound worst-case imbalance locality.
+    group_size: int = 1024
+
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
         B, S, D = x.shape
         E, K = self.n_experts, self.top_k
         G = B * S
-        C = max(1, int(np.ceil(G * self.capacity_factor * K / E)))
-        xf = x.reshape(G, D)
+        gs = _fit_group(G, self.group_size)
+        ng = G // gs
+        C = max(1, int(np.ceil(gs * self.capacity_factor * K / E)))
+        xg = x.reshape(ng, gs, D)
 
         router = self.param("router", nn.initializers.normal(0.02),
                             (D, E), jnp.float32)
-        logits = (xf.astype(jnp.float32) @ router)          # [G, E]
-        probs = jax.nn.softmax(logits, axis=-1)
+        logits = jnp.einsum("nsd,de->nse", xg.astype(jnp.float32), router)
+        probs = jax.nn.softmax(logits, axis=-1)               # [ng, gs, E]
 
         # top-k selection, normalized combine weights
-        top_w, top_e = jax.lax.top_k(probs, K)              # [G, K]
+        top_w, top_e = jax.lax.top_k(probs, K)                # [ng, gs, K]
         top_w = top_w / jnp.maximum(top_w.sum(-1, keepdims=True), 1e-9)
 
-        # position of each (token, choice) in its expert's capacity buffer
-        onehot = jax.nn.one_hot(top_e, E, dtype=jnp.float32)   # [G, K, E]
-        flat = onehot.reshape(G * K, E)
-        pos = (jnp.cumsum(flat, axis=0) - flat).reshape(G, K, E)
-        pos = (pos * onehot).sum(-1).astype(jnp.int32)      # [G, K]
-        within = pos < C                                    # capacity fit
+        # position of each (token, choice) in its expert's per-group
+        # capacity buffer: running count within the group
+        onehot = jax.nn.one_hot(top_e, E, dtype=jnp.float32)  # [ng,gs,K,E]
+        flat = onehot.reshape(ng, gs * K, E)
+        pos = (jnp.cumsum(flat, axis=1) - flat).reshape(ng, gs, K, E)
+        pos = (pos * onehot).sum(-1).astype(jnp.int32)        # [ng, gs, K]
+        within = pos < C                                      # capacity fit
 
-        # dispatch [G, E, C] / combine [G, E, C]
-        pos_oh = jax.nn.one_hot(pos, C, dtype=jnp.float32)  # [G, K, C]
-        disp = jnp.einsum("gke,gkc->gec",
+        # dispatch / combine [ng, gs, E, C]
+        pos_oh = jax.nn.one_hot(pos, C, dtype=jnp.float32)    # [ng,gs,K,C]
+        disp = jnp.einsum("nske,nskc->nsec",
                           onehot * within[..., None], pos_oh)
-        comb = jnp.einsum("gke,gkc->gec",
+        comb = jnp.einsum("nske,nskc->nsec",
                           onehot * (top_w * within)[..., None], pos_oh)
 
         w_gate_up = self.param(
@@ -84,20 +103,20 @@ class MoEMLP(nn.Module):
             (E, self.hidden_dim, D), jnp.float32)
 
         expert_in = jnp.einsum(
-            "gd,gec->ecd", xf.astype(self.dtype), disp.astype(self.dtype))
+            "nsd,nsec->necd", xg.astype(self.dtype), disp.astype(self.dtype))
         gate_up = jnp.einsum(
-            "ecd,edf->ecf", expert_in, w_gate_up.astype(self.dtype))
+            "necd,edf->necf", expert_in, w_gate_up.astype(self.dtype))
         gate, up = jnp.split(gate_up, 2, axis=-1)
         h = nn.silu(gate) * up
         expert_out = jnp.einsum(
-            "ecf,efd->ecd", h, w_down.astype(self.dtype))
+            "necf,efd->necd", h, w_down.astype(self.dtype))
         y = jnp.einsum(
-            "ecd,gec->gd", expert_out, comb.astype(self.dtype))
+            "necd,nsec->nsd", expert_out, comb.astype(self.dtype))
 
         # Switch-style load-balance loss: E * sum_e f_e * p_e where f is
         # the dispatched fraction and p the mean router probability.
-        frac = (onehot * within[..., None]).sum(1).mean(0)  # [E]
-        mean_p = probs.mean(0)
+        frac = (onehot * within[..., None]).sum(2).mean((0, 1))   # [E]
+        mean_p = probs.mean((0, 1))
         aux = E * jnp.sum(frac * mean_p)
         return y.reshape(B, S, D).astype(x.dtype), aux
 

@@ -17,8 +17,14 @@ from ray_lightning_tpu.ops.ring_attention import (
     ring_attention_local,
 )
 from ray_lightning_tpu.ops.rope import apply_rope, rope_frequencies
+from ray_lightning_tpu.ops.ulysses import (
+    ulysses_attention,
+    ulysses_attention_local,
+)
 
 __all__ = [
+    "ulysses_attention",
+    "ulysses_attention_local",
     "dot_product_attention",
     "flash_attention",
     "make_causal_mask",

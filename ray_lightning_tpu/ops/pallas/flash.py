@@ -59,8 +59,11 @@ def shapes_supported(q_shape, k_shape) -> bool:
         return False
     if sq % 8 != 0 or sk % 8 != 0:  # sublane alignment
         return False
-    return (sq % _fit_block(DEFAULT_BLOCK_Q, sq) == 0
-            and sk % _fit_block(DEFAULT_BLOCK_K, sk) == 0)
+    # blocks below 128 starve the MXU (8-wide tiles on S=8*odd would
+    # "fit" but run far slower than the fused XLA path) — fall back.
+    bq, bk = _fit_block(DEFAULT_BLOCK_Q, sq), _fit_block(DEFAULT_BLOCK_K, sk)
+    return (sq % bq == 0 and bq >= min(sq, 128)
+            and sk % bk == 0 and bk >= min(sk, 128))
 
 
 # ----------------------------------------------------------------- forward

@@ -127,8 +127,23 @@ def ring_attention_local(
     return out.transpose(0, 2, 1, 3).astype(q.dtype)      # [B, Sq, H, D]
 
 
-def _batch_axes(mesh: Mesh) -> tuple:
-    return tuple(ax for ax in ("data", "fsdp", "expert") if ax in mesh.shape)
+def seq_island(local_fn, mesh: Mesh, axis_name: str = "seq", **kwargs):
+    """Shared shard_map wrapper for sequence-parallel attention islands
+    ([B, S, H, D] tensors: batch over the data axes, sequence over
+    `axis_name`, heads over `tensor`). Used by both the ring and the
+    ulysses (ops/ulysses.py) modes so they cannot disagree on layout."""
+    bspec = tuple(ax for ax in ("data", "fsdp", "expert")
+                  if ax in mesh.shape)
+    head_ax = "tensor" if "tensor" in mesh.shape else None
+    spec = P(bspec if bspec else None, axis_name, head_ax, None)
+    return jax.shard_map(
+        partial(local_fn, axis_name=axis_name,
+                axis_size=mesh.shape[axis_name], **kwargs),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,  # collective-permute varying-axes opt-out
+    )
 
 
 def ring_attention(
@@ -147,18 +162,6 @@ def ring_attention(
     ride `tensor`, sequence is split over `axis_name`. With axis size 1
     this degrades to plain blockwise attention on every device.
     """
-    n = mesh.shape[axis_name]
-    bspec = _batch_axes(mesh)
-    head_ax = "tensor" if "tensor" in mesh.shape else None
-    spec = P(bspec if bspec else None, axis_name, head_ax, None)
-    fn = jax.shard_map(
-        partial(
-            ring_attention_local,
-            axis_name=axis_name, axis_size=n, causal=causal, scale=scale,
-        ),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        check_vma=False,  # ppermute's varying-mesh-axes inference opt-out
-    )
+    fn = seq_island(ring_attention_local, mesh, axis_name,
+                    causal=causal, scale=scale)
     return fn(q, k, v)

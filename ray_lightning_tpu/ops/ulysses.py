@@ -1,0 +1,88 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence exchange.
+
+The second sequence-parallel scheme (DeepSpeed-Ulysses pattern),
+complementing ring attention (ops/ring_attention.py):
+
+  * ring: KV blocks rotate the `seq` ring; n-1 nearest-neighbor
+    `ppermute`s; attention stays blockwise-local. Best at very long S
+    (activation memory O(S/n)) and on torus topologies.
+  * ulysses: ONE `all_to_all` converts the layout from sequence-sharded
+    [B, S/n, H, D] to head-sharded [B, S, H/n, D], each device runs
+    plain (flash) attention over the FULL sequence for its head group,
+    and a second all_to_all restores the sequence sharding. Two
+    collectives total regardless of n — cheaper than the ring when the
+    full-S working set still fits one device and H % n == 0.
+
+Both compose with the same mesh axes; the Llama family picks via
+`LlamaConfig.seq_parallel_mode`.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ray_lightning_tpu.ops.attention import flash_attention
+from ray_lightning_tpu.ops.ring_attention import seq_island
+
+
+def ulysses_attention_local(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: str = "seq",
+    axis_size: int,
+    causal: bool = True,
+    use_pallas: Optional[bool] = None,
+):
+    """Per-shard body (inside shard_map): q, k, v are [B, S/n, H(,kv), D]."""
+    if axis_size == 1:
+        return flash_attention(q, k, v, causal=causal, use_pallas=use_pallas)
+
+    def to_heads(x):
+        # [B, S/n, H, D] -> all_to_all over the head axis -> [B, S, H/n, D]
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def to_seq(x):
+        # inverse: [B, S, H/n, D] -> [B, S/n, H, D]
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    out = flash_attention(qh, kh, vh, causal=causal, use_pallas=use_pallas)
+    return to_seq(out)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    *,
+    axis_name: str = "seq",
+    causal: bool = True,
+    use_pallas: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Sequence-parallel attention via head/sequence all-to-all.
+
+    Global [B, S, H, D] in/out, sequence split over `axis_name`.
+    Requires H (and the KV head count) divisible by the axis size.
+    """
+    n = mesh.shape[axis_name]
+    # heads are already split over `tensor` inside the island — the
+    # all_to_all redistributes the LOCAL head count
+    t = mesh.shape.get("tensor", 1)
+    h_local, hkv_local = q.shape[2] // t, k.shape[2] // t
+    if h_local % n != 0 or hkv_local % n != 0:
+        raise ValueError(
+            f"ulysses needs per-shard heads divisible by the seq axis: "
+            f"H/tensor={h_local}, Hkv/tensor={hkv_local}, seq={n} — use "
+            "ring attention for this shape"
+        )
+    fn = seq_island(ulysses_attention_local, mesh, axis_name,
+                    causal=causal, use_pallas=use_pallas)
+    return fn(q, k, v)

@@ -119,3 +119,51 @@ def test_llama_trains_with_seq_parallel(devices8, tmp_path):
     assert trainer.global_step == 2
     assert np.isfinite(float(trainer.callback_metrics["loss"]))
     assert module.model.mesh is not None  # the ring path was built
+
+
+# ------------------------------------------------------ ulysses variant
+
+
+def test_ulysses_matches_full_attention(devices8):
+    from ray_lightning_tpu.ops import ulysses_attention
+
+    mesh = make_mesh(data=2, seq=4, devices=devices8)
+    q, k, v = _qkv(B=4, S=32, H=4, Hkv=4, D=8)
+    for causal in (True, False):
+        ref = dot_product_attention(q, k, v, causal=causal)
+        out = ulysses_attention(q, k, v, mesh, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_rejects_indivisible_heads(devices8):
+    import pytest as _pytest
+
+    from ray_lightning_tpu.ops import ulysses_attention
+
+    mesh = make_mesh(seq=4, devices=devices8[:4])
+    q, k, v = _qkv(H=4, Hkv=2)  # Hkv=2 not divisible by seq=4
+    with _pytest.raises(ValueError, match="ring"):
+        ulysses_attention(q, k, v, mesh)
+
+
+def test_llama_ulysses_mode_matches_dense(devices8):
+    import dataclasses
+
+    from ray_lightning_tpu.models.llama import Llama, LlamaConfig
+
+    cfg = LlamaConfig.tiny(use_flash=False, dtype=jnp.float32,
+                           n_heads=8, n_kv_heads=4)
+    tokens = np.asarray(
+        jax.random.randint(jax.random.key(0), (2, 32), 0, cfg.vocab_size),
+        dtype=np.int32,
+    )
+    params = jax.jit(Llama(cfg).init)(jax.random.key(1), tokens)["params"]
+    ref = _llama_logits(cfg, params, tokens)
+
+    mesh = make_mesh(data=2, seq=2, tensor=2, devices=devices8)
+    sp_cfg = dataclasses.replace(cfg, seq_parallel=True,
+                                 seq_parallel_mode="ulysses")
+    out = _llama_logits(sp_cfg, params, tokens, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
