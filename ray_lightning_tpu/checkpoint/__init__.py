@@ -2,6 +2,8 @@ from ray_lightning_tpu.checkpoint.io import (
     save_checkpoint,
     load_checkpoint,
     restore_checkpoint,
+    wait_for_checkpoints,
 )
 
-__all__ = ["save_checkpoint", "load_checkpoint", "restore_checkpoint"]
+__all__ = ["save_checkpoint", "load_checkpoint", "restore_checkpoint",
+           "wait_for_checkpoints"]

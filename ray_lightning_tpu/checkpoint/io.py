@@ -28,20 +28,34 @@ import orbax.checkpoint as ocp
 _STATE_DIR = "state"
 _META_FILE = "meta.json"
 
+# Singleton: StandardCheckpointer is an AsyncCheckpointer — in-flight
+# background writes must not be garbage-collected with a per-call
+# instance, and wait_for_checkpoints() needs a handle to join them.
+_CKPT: Optional[ocp.StandardCheckpointer] = None
+
 
 def _checkpointer() -> ocp.StandardCheckpointer:
-    return ocp.StandardCheckpointer()
+    global _CKPT
+    if _CKPT is None:
+        _CKPT = ocp.StandardCheckpointer()
+    return _CKPT
 
 
 def save_checkpoint(
     path: str,
     state: Dict[str, Any],
     meta: Optional[Dict[str, Any]] = None,
+    block: bool = True,
 ) -> str:
     """Write `state` (pytree of possibly-sharded jax.Arrays) + metadata.
 
-    Multi-host safe: every process must call this collectively; orbax writes
-    each host's addressable shards.
+    Multi-host safe: every process must call this collectively; orbax
+    writes each host's addressable shards.
+
+    ``block=False`` returns as soon as the device->host copy is done and
+    streams the disk write in the background (training continues during
+    I/O — the big-model checkpoint stall killer); join with
+    `wait_for_checkpoints()` before reading the files or exiting.
     """
     path = os.path.abspath(path)
     os.makedirs(path, exist_ok=True)
@@ -51,11 +65,18 @@ def save_checkpoint(
         meta["hparams_pickle_hex"] = pickle.dumps(hparams).hex()
     ck = _checkpointer()
     ck.save(os.path.join(path, _STATE_DIR), state, force=True)
-    ck.wait_until_finished()
+    if block:
+        ck.wait_until_finished()
     if jax.process_index() == 0:
         with open(os.path.join(path, _META_FILE), "w") as f:
             json.dump(meta, f)
     return path
+
+
+def wait_for_checkpoints() -> None:
+    """Join all in-flight async checkpoint writes (no-op when none)."""
+    if _CKPT is not None:
+        _CKPT.wait_until_finished()
 
 
 def load_checkpoint(path: str) -> Dict[str, Any]:

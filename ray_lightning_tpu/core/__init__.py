@@ -2,6 +2,11 @@ from ray_lightning_tpu.core.module import TpuModule
 from ray_lightning_tpu.core.trainer import Trainer
 from ray_lightning_tpu.core.state import TrainState
 from ray_lightning_tpu.core.data import DataLoader, DataModule
+from ray_lightning_tpu.core.text import (
+    chunk_tokens,
+    pack_sequences,
+    tokenize_and_pack,
+)
 from ray_lightning_tpu.core.callbacks import (
     Callback,
     EarlyStopping,
@@ -16,6 +21,9 @@ __all__ = [
     "TrainState",
     "DataLoader",
     "DataModule",
+    "chunk_tokens",
+    "pack_sequences",
+    "tokenize_and_pack",
     "Callback",
     "EarlyStopping",
     "ModelCheckpoint",

@@ -83,14 +83,24 @@ class ModelCheckpoint(Callback):
 
     def __init__(self, dirpath: Optional[str] = None, monitor: Optional[str] = None,
                  mode: str = "min", save_top_k: int = 1, save_last: bool = False,
-                 every_n_epochs: int = 1, filename: str = "epoch={epoch}"):
+                 every_n_epochs: int = 1,
+                 every_n_train_steps: Optional[int] = None,
+                 filename: str = "epoch={epoch}",
+                 async_save: bool = False):
         self.dirpath = dirpath
         self.monitor = monitor
         self.mode = mode
         self.save_top_k = save_top_k
         self.save_last = save_last
         self.every_n_epochs = max(1, every_n_epochs)
+        #: step-based cadence (LLM-style long epochs); saves are
+        #: unmonitored at step boundaries (metrics lag validation)
+        self.every_n_train_steps = every_n_train_steps
         self.filename = filename
+        #: async_save=True streams the disk write in the background
+        #: (checkpoint/io.py block=False); the Trainer joins in-flight
+        #: writes at fit end.
+        self.async_save = async_save
         self.best_model_path: str = ""
         self.best_model_score: Optional[float] = None
         self.last_model_path: str = ""
@@ -108,17 +118,30 @@ class ModelCheckpoint(Callback):
             return None
         return float(metrics[self.monitor])
 
-    def _maybe_save(self, trainer, module, metrics: Dict[str, Any]) -> None:
-        if trainer.current_epoch % self.every_n_epochs != 0:
+    def _maybe_save(self, trainer, module, metrics: Dict[str, Any],
+                    step_based: bool = False) -> None:
+        if not step_based and trainer.current_epoch % self.every_n_epochs != 0:
             return
         d = self._resolve_dir(trainer)
         name = self.filename.format(epoch=trainer.current_epoch,
                                     step=trainer.global_step)
+        if step_based and "{step" not in self.filename:
+            name = f"step={trainer.global_step}"
         path = os.path.join(d, name)
+        if step_based:
+            # step cadence ignores `monitor` (metrics lag validation):
+            # recency-tracked like the unmonitored path, pruned to
+            # save_top_k so long runs stay disk-bounded.
+            trainer.save_checkpoint(path, block=not self.async_save)
+            self.best_model_path = path
+            self.last_model_path = path
+            self._saved.append((-float(trainer.global_step), path))
+            self._prune()
+            return
         score = self._score(metrics)
         if self.monitor is not None and score is None:
             return  # monitored metric absent this epoch
-        trainer.save_checkpoint(path)
+        trainer.save_checkpoint(path, block=not self.async_save)
         if self.save_last:
             self.last_model_path = path
         if self.monitor is None:
@@ -143,11 +166,20 @@ class ModelCheckpoint(Callback):
                 _rmtree_quiet(stale)
         self._saved = self._saved[: self.save_top_k]
 
+    def on_train_batch_end(self, trainer, module, metrics, batch_idx) -> None:
+        if (self.every_n_train_steps
+                and trainer.global_step % self.every_n_train_steps == 0):
+            self._maybe_save(trainer, module, trainer.callback_metrics,
+                             step_based=True)
+
     def on_validation_epoch_end(self, trainer, module, metrics) -> None:
-        self._maybe_save(trainer, module, metrics)
+        # cadences are mutually exclusive (PTL semantics): a step-based
+        # checkpoint never also saves at epoch boundaries
+        if not self.every_n_train_steps:
+            self._maybe_save(trainer, module, metrics)
 
     def on_train_epoch_end(self, trainer, module) -> None:
-        if not trainer.has_validation:
+        if not trainer.has_validation and not self.every_n_train_steps:
             self._maybe_save(trainer, module, trainer.callback_metrics)
 
 

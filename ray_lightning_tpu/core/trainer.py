@@ -32,7 +32,11 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ray_lightning_tpu.checkpoint import restore_checkpoint, save_checkpoint
+from ray_lightning_tpu.checkpoint import (
+    restore_checkpoint,
+    save_checkpoint,
+    wait_for_checkpoints,
+)
 from ray_lightning_tpu.checkpoint.io import read_meta
 from ray_lightning_tpu.core.callbacks import (
     Callback,
@@ -162,6 +166,9 @@ class Trainer:
             self._invoke("on_exception", exc)
             raise
         finally:
+            # join in-flight async checkpoint writes before anything can
+            # read the files or the process exits
+            wait_for_checkpoints()
             # Parity C5: the driver-side module object holds trained weights.
             if self.state is not None:
                 module.params = self.state.params
@@ -287,7 +294,7 @@ class Trainer:
 
     # --------------------------------------------------------- checkpoints
 
-    def save_checkpoint(self, path: str) -> str:
+    def save_checkpoint(self, path: str, block: bool = True) -> str:
         assert self.state is not None, "nothing to save; fit first"
         ckpt_meta = {
             "epoch": self.current_epoch,
@@ -302,7 +309,7 @@ class Trainer:
         }
         self.module.on_save_checkpoint(checkpoint)
         self._invoke("on_save_checkpoint", checkpoint)
-        return save_checkpoint(path, checkpoint, ckpt_meta)
+        return save_checkpoint(path, checkpoint, ckpt_meta, block=block)
 
     # ------------------------------------------------------------ plumbing
 
