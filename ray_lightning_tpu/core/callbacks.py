@@ -134,7 +134,8 @@ class ModelCheckpoint(Callback):
             # save_top_k so long runs stay disk-bounded.
             trainer.save_checkpoint(path, block=not self.async_save)
             self.best_model_path = path
-            self.last_model_path = path
+            if self.save_last:
+                self.last_model_path = path
             self._saved.append((-float(trainer.global_step), path))
             self._prune()
             return

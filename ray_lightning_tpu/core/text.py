@@ -23,7 +23,7 @@ def _rows_from_stream(t: np.ndarray, seq_len: int, pad_id: int,
     rows need none, so "mask" is only emitted when padding exists.
     """
     stride = seq_len
-    n = (len(t) - 1) // stride
+    n = max(0, (len(t) - 1) // stride)  # empty stream must not underflow
     rows = []
     if n >= 1:
         windows = np.lib.stride_tricks.sliding_window_view(t, seq_len + 1)

@@ -62,6 +62,7 @@ class Trainer:
         limit_train_batches: Optional[int] = None,
         limit_val_batches: Optional[int] = None,
         check_val_every_n_epoch: int = 1,
+        val_check_interval: Optional[int] = None,
         log_every_n_steps: int = 50,
         accumulate_grad_batches: int = 1,
         gradient_clip_val: Optional[float] = None,
@@ -79,6 +80,9 @@ class Trainer:
         self.limit_train_batches = limit_train_batches
         self.limit_val_batches = limit_val_batches
         self.check_val_every_n_epoch = max(1, check_val_every_n_epoch)
+        #: mid-epoch validation every N optimizer steps (long-epoch /
+        #: streaming LLM runs where epoch boundaries are meaningless)
+        self.val_check_interval = val_check_interval
         self.log_every_n_steps = log_every_n_steps
         self.accumulate_grad_batches = max(1, accumulate_grad_batches)
         self.gradient_clip_val = gradient_clip_val
@@ -109,6 +113,7 @@ class Trainer:
         self.global_step = 0
         self.should_stop = False
         self.has_validation = False
+        self._last_val_step = -1
         self.last_batch_size: Optional[int] = None
         self._train_step = None
         self._eval_step = None
@@ -167,8 +172,12 @@ class Trainer:
             raise
         finally:
             # join in-flight async checkpoint writes before anything can
-            # read the files or the process exits
-            wait_for_checkpoints()
+            # read the files or the process exits; a deferred write error
+            # must not displace an in-flight training exception
+            try:
+                wait_for_checkpoints()
+            except Exception:  # noqa: BLE001
+                log.exception("async checkpoint write failed")
             # Parity C5: the driver-side module object holds trained weights.
             if self.state is not None:
                 module.params = self.state.params
@@ -186,15 +195,19 @@ class Trainer:
                     train_loader.set_epoch(epoch)
                 self.module.on_train_epoch_start(self)
                 self._invoke("on_train_epoch_start")
-                self._run_train_epoch(train_loader)
+                self._run_train_epoch(train_loader, val_loader)
                 run_val = (
                     self.has_validation
                     and (epoch + 1) % self.check_val_every_n_epoch == 0
+                    # mid-epoch interval may have just validated this
+                    # exact step — don't run twice on identical weights
+                    and self.global_step != self._last_val_step
                 )
                 if run_val:
                     metrics = self._run_eval_epoch(
                         val_loader, limit=self.limit_val_batches
                     )
+                    self._last_val_step = self.global_step
                     self.callback_metrics.update(metrics)
                     self.module.on_validation_epoch_end(self, metrics)
                     self._invoke("on_validation_epoch_end", metrics)
@@ -203,7 +216,7 @@ class Trainer:
                 if self.should_stop or self._hit_max_steps():
                     break
 
-    def _run_train_epoch(self, loader) -> None:
+    def _run_train_epoch(self, loader, val_loader=None) -> None:
         pending: Dict[str, Any] = {}
         for batch_idx, batch in enumerate(loader):
             if (
@@ -225,6 +238,15 @@ class Trainer:
                 self.callback_metrics.update(host)
                 pending = host
             self._invoke("on_train_batch_end", pending, batch_idx)
+            if (self.val_check_interval and self.has_validation
+                    and val_loader is not None
+                    and self.global_step % self.val_check_interval == 0):
+                metrics = self._run_eval_epoch(
+                    val_loader, limit=self.limit_val_batches)
+                self._last_val_step = self.global_step
+                self.callback_metrics.update(metrics)
+                self.module.on_validation_epoch_end(self, metrics)
+                self._invoke("on_validation_epoch_end", metrics)
             if self.should_stop or self._hit_max_steps():
                 break
         if pending:

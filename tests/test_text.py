@@ -61,3 +61,10 @@ def test_chunk_short_stream_keep_tail():
     assert out["mask"][0].sum() == 4  # 4 real targets, rest padded
     with pytest.raises(ValueError):
         chunk_tokens(np.arange(4), seq_len=8)  # drop_last=True still raises
+
+
+def test_empty_stream_raises_not_fabricates():
+    with pytest.raises(ValueError, match="cannot fill"):
+        pack_sequences([], seq_len=8, drop_last=False)
+    with pytest.raises(ValueError, match="cannot fill"):
+        chunk_tokens(np.zeros(0, np.int32), seq_len=8, drop_last=False)

@@ -115,3 +115,47 @@ def test_bad_batch_divisibility_raises(tmp_path):
     loader = DataLoader(random_dataset(n=60), batch_size=30, drop_last=True)
     with pytest.raises(ValueError, match="not divisible"):
         trainer.fit(module, loader)
+
+
+def test_val_check_interval(devices8, tmp_path):
+    """Mid-epoch validation fires every N steps (long-epoch LLM runs)."""
+    from ray_lightning_tpu import DataLoader, SingleDevice, Trainer
+
+    from tests.utils import BoringModel, random_dataset
+
+    data = random_dataset(n=256)
+    module = BoringModel()
+    seen = []
+    module.on_validation_epoch_end = (
+        lambda trainer, metrics: seen.append(trainer.global_step))
+    trainer = Trainer(
+        strategy=SingleDevice(), max_epochs=1, val_check_interval=3,
+        default_root_dir=str(tmp_path), enable_checkpointing=False,
+        enable_progress_bar=False,
+    )
+    trainer.fit(module, DataLoader(data, batch_size=32),   # 8 steps
+                DataLoader(data, batch_size=32))
+    # steps 3 and 6 mid-epoch, plus the end-of-epoch validation
+    assert seen == [3, 6, 8]
+
+
+def test_val_check_interval_no_double_at_boundary(devices8, tmp_path):
+    """Interval dividing the epoch length must not validate twice on the
+    same step at the epoch boundary."""
+    from ray_lightning_tpu import DataLoader, SingleDevice, Trainer
+
+    from tests.utils import BoringModel, random_dataset
+
+    data = random_dataset(n=256)
+    module = BoringModel()
+    seen = []
+    module.on_validation_epoch_end = (
+        lambda trainer, metrics: seen.append(trainer.global_step))
+    trainer = Trainer(
+        strategy=SingleDevice(), max_epochs=1, val_check_interval=4,
+        default_root_dir=str(tmp_path), enable_checkpointing=False,
+        enable_progress_bar=False,
+    )
+    trainer.fit(module, DataLoader(data, batch_size=32),   # 8 steps
+                DataLoader(data, batch_size=32))
+    assert seen == [4, 8]
