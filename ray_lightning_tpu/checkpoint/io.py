@@ -20,13 +20,18 @@ from __future__ import annotations
 import json
 import os
 import pickle
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import orbax.checkpoint as ocp
 
 _STATE_DIR = "state"
 _META_FILE = "meta.json"
+
+# meta.json writes deferred until their async state write finalizes —
+# meta.json presence is the "checkpoint is complete" marker, so it must
+# never exist over a still-streaming (or failed) state dir.
+_PENDING_META: List[Tuple[str, Dict[str, Any]]] = []
 
 # Singleton: StandardCheckpointer is an AsyncCheckpointer — in-flight
 # background writes must not be garbage-collected with a per-call
@@ -67,16 +72,56 @@ def save_checkpoint(
     ck.save(os.path.join(path, _STATE_DIR), state, force=True)
     if block:
         ck.wait_until_finished()
-    if jax.process_index() == 0:
-        with open(os.path.join(path, _META_FILE), "w") as f:
-            json.dump(meta, f)
+        # the join above finalized EVERY in-flight write, including earlier
+        # async ones — flush their deferred metas too, then write ours
+        _flush_pending_meta()
+        _write_meta(path, meta)
+    else:
+        # meta.json is the completeness marker — defer it until
+        # wait_for_checkpoints() confirms the state write finalized.
+        _PENDING_META.append((path, meta))
     return path
 
 
+def _write_meta(path: str, meta: Dict[str, Any]) -> None:
+    if jax.process_index() == 0:
+        with open(os.path.join(path, _META_FILE), "w") as f:
+            json.dump(meta, f)
+
+
+def _flush_pending_meta() -> None:
+    global _PENDING_META
+    pending, _PENDING_META = _PENDING_META, []
+    for path, meta in pending:
+        _write_meta(path, meta)
+
+
+def discard_pending_meta(path: str) -> bool:
+    """Forget the deferred meta for `path` (its checkpoint dir is being
+    deleted). Returns True if an entry existed — i.e. the state write may
+    still be streaming into that dir, so callers should join in-flight
+    writes before removing it."""
+    global _PENDING_META
+    p = os.path.abspath(path)
+    had = any(pp == p for pp, _ in _PENDING_META)
+    if had:
+        _PENDING_META = [(pp, m) for pp, m in _PENDING_META if pp != p]
+    return had
+
+
 def wait_for_checkpoints() -> None:
-    """Join all in-flight async checkpoint writes (no-op when none)."""
-    if _CKPT is not None:
-        _CKPT.wait_until_finished()
+    """Join all in-flight async checkpoint writes (no-op when none), then
+    finalize their meta.json markers. If any write failed, NO deferred meta
+    is written (conservative: an un-finalized dir reads as no checkpoint)
+    and the error propagates to the caller."""
+    global _PENDING_META
+    try:
+        if _CKPT is not None:
+            _CKPT.wait_until_finished()
+    except Exception:
+        _PENDING_META = []
+        raise
+    _flush_pending_meta()
 
 
 def load_checkpoint(path: str) -> Dict[str, Any]:

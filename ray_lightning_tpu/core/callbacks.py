@@ -127,11 +127,18 @@ class ModelCheckpoint(Callback):
                                     step=trainer.global_step)
         if step_based and "{step" not in self.filename:
             name = f"step={trainer.global_step}"
+        elif (not step_based and trainer.val_check_interval
+                and "{step" not in self.filename):
+            # mid-epoch validation (val_check_interval) saves several times
+            # per epoch; disambiguate the default epoch-only filename so
+            # saves don't overwrite each other within an epoch
+            name = f"{name}-step={trainer.global_step}"
         path = os.path.join(d, name)
         if step_based:
             # step cadence ignores `monitor` (metrics lag validation):
             # recency-tracked like the unmonitored path, pruned to
             # save_top_k so long runs stay disk-bounded.
+            self._dedupe(path)
             trainer.save_checkpoint(path, block=not self.async_save)
             self.best_model_path = path
             if self.save_last:
@@ -142,6 +149,7 @@ class ModelCheckpoint(Callback):
         score = self._score(metrics)
         if self.monitor is not None and score is None:
             return  # monitored metric absent this epoch
+        self._dedupe(path)
         trainer.save_checkpoint(path, block=not self.async_save)
         if self.save_last:
             self.last_model_path = path
@@ -158,13 +166,19 @@ class ModelCheckpoint(Callback):
             self.best_model_path = path
         self._prune()
 
+    def _dedupe(self, path: str) -> None:
+        # re-saving an existing path must replace, not duplicate, its
+        # _saved entry — duplicates distort save_top_k accounting. Called
+        # only on the branches that actually save to `path`.
+        self._saved = [(s, p) for s, p in self._saved if p != path]
+
     def _prune(self) -> None:
         if self.save_top_k <= 0:
             return
         self._saved.sort(key=lambda t: t[0])
         for _, stale in self._saved[self.save_top_k:]:
             if stale not in (self.best_model_path, self.last_model_path):
-                _rmtree_quiet(stale)
+                _remove_checkpoint(stale)
         self._saved = self._saved[: self.save_top_k]
 
     def on_train_batch_end(self, trainer, module, metrics, batch_idx) -> None:
@@ -222,6 +236,27 @@ class ProgressLogger(Callback):
                       for k, v in metrics.items()}
             log.info("epoch %d step %d %s", trainer.current_epoch,
                      trainer.global_step, pretty)
+
+
+def _remove_checkpoint(path: str) -> None:
+    """Delete a pruned checkpoint dir, safely against in-flight async
+    writes: if its state write is still streaming, join it first (else
+    orbax's background finalize could resurrect the dir, or a deferred
+    meta.json write could land in a deleted directory)."""
+    from ray_lightning_tpu.checkpoint.io import (
+        discard_pending_meta,
+        wait_for_checkpoints,
+    )
+
+    if discard_pending_meta(path):
+        try:
+            wait_for_checkpoints()
+        except Exception:  # noqa: BLE001
+            # the failed write may concern a KEPT checkpoint, but its
+            # pending meta was already dropped by wait_for_checkpoints'
+            # conservative error path — nothing more to do than log
+            log.exception("async checkpoint write failed during prune")
+    _rmtree_quiet(path)
 
 
 def _rmtree_quiet(path: str) -> None:

@@ -114,6 +114,12 @@ class Trainer:
         self.should_stop = False
         self.has_validation = False
         self._last_val_step = -1
+        # mid-epoch bookkeeping for checkpoint/resume: whether we are
+        # inside a partially-consumed train epoch, how many batches of the
+        # current epoch ran, and how many to skip after a mid-epoch resume
+        self._mid_epoch = False
+        self._epoch_batches_done = 0
+        self._resume_skip_batches = 0
         self.last_batch_size: Optional[int] = None
         self._train_step = None
         self._eval_step = None
@@ -161,6 +167,7 @@ class Trainer:
 
         module.on_fit_start(self)
         self._invoke("on_fit_start")
+        fit_error: Optional[BaseException] = None
         try:
             if self.num_sanity_val_steps and self.has_validation:
                 self._run_eval_epoch(
@@ -168,15 +175,20 @@ class Trainer:
                 )
             self._fit_loop(train_dataloaders, val_dataloaders)
         except BaseException as exc:  # surface to callbacks, then re-raise
+            fit_error = exc
             self._invoke("on_exception", exc)
             raise
         finally:
             # join in-flight async checkpoint writes before anything can
-            # read the files or the process exits; a deferred write error
-            # must not displace an in-flight training exception
+            # read the files or the process exits. A deferred write error
+            # must not displace an in-flight training exception — but on
+            # the success path it IS the failure (best_model_path must
+            # never point at an unfinalized checkpoint), so re-raise.
             try:
                 wait_for_checkpoints()
             except Exception:  # noqa: BLE001
+                if fit_error is None:
+                    raise
                 log.exception("async checkpoint write failed")
             # Parity C5: the driver-side module object holds trained weights.
             if self.state is not None:
@@ -218,11 +230,31 @@ class Trainer:
 
     def _run_train_epoch(self, loader, val_loader=None) -> None:
         pending: Dict[str, Any] = {}
-        for batch_idx, batch in enumerate(loader):
+        # Mid-epoch resume: fast-forward past already-consumed batches so a
+        # checkpoint saved by every_n_train_steps/val_check_interval resumes
+        # the SAME epoch at the right offset (loaders reshuffle
+        # deterministically per epoch via set_epoch, so offsets are stable).
+        skip = self._resume_skip_batches
+        self._resume_skip_batches = 0
+        self._mid_epoch = True
+        self._epoch_batches_done = skip
+        it = iter(loader)
+        for _ in range(skip):
+            if next(it, None) is None:
+                break
+        completed = False
+        # start=skip: callbacks must see the true intra-epoch batch index
+        # after a mid-epoch resume
+        for batch_idx, batch in enumerate(it, start=skip):
             if (
                 self.limit_train_batches is not None
-                and batch_idx >= self.limit_train_batches
+                # count from epoch start, not resume point, so a resumed
+                # epoch sees limit - already_consumed more batches
+                and self._epoch_batches_done >= self.limit_train_batches
             ):
+                # the limit DEFINES the epoch length (PTL semantics), so
+                # hitting it is epoch completion, not a mid-epoch cut
+                completed = True
                 break
             batch = self._cast(batch)
             self.last_batch_size = _leading_dim(batch)
@@ -231,6 +263,7 @@ class Trainer:
                 self.state, device_batch, self._base_rng
             )
             self.global_step += 1
+            self._epoch_batches_done += 1
             pending = metrics
             # Lazy metric fetch: only sync on the logging cadence.
             if self.global_step % max(1, self.log_every_n_steps) == 0:
@@ -249,6 +282,13 @@ class Trainer:
                 self._invoke("on_validation_epoch_end", metrics)
             if self.should_stop or self._hit_max_steps():
                 break
+        else:
+            completed = True
+        if completed:
+            # every batch of this epoch was consumed — subsequent saves
+            # (epoch-boundary validation / on_train_epoch_end) resume at
+            # the NEXT epoch
+            self._mid_epoch = False
         if pending:
             self.callback_metrics.update(_to_host(pending))
 
@@ -321,6 +361,11 @@ class Trainer:
         ckpt_meta = {
             "epoch": self.current_epoch,
             "global_step": self.global_step,
+            # mid-epoch saves (every_n_train_steps / val_check_interval)
+            # record the batch offset so resume replays the REST of the
+            # epoch instead of silently skipping it
+            "mid_epoch": self._mid_epoch,
+            "epoch_batch": self._epoch_batches_done,
             "module_class": type(self.module).__name__,
             "hparams": self.module.hparams,
         }
@@ -415,7 +460,14 @@ class Trainer:
                  "step": state.step},
             )
             meta = read_meta(ckpt_path)
-            self.current_epoch = int(meta.get("epoch", -1)) + 1
+            saved_epoch = int(meta.get("epoch", -1))
+            if meta.get("mid_epoch", False):
+                # checkpoint taken inside a partially-trained epoch:
+                # resume the SAME epoch, skipping the consumed batches
+                self.current_epoch = max(0, saved_epoch)
+                self._resume_skip_batches = int(meta.get("epoch_batch", 0))
+            else:
+                self.current_epoch = saved_epoch + 1
             self.global_step = int(meta.get("global_step", 0))
             module.on_load_checkpoint(restored)
             self._invoke("on_load_checkpoint", restored)

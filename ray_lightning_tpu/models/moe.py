@@ -37,8 +37,9 @@ from ray_lightning_tpu.core.module import TpuModule
 
 
 def _fit_group(total: int, target: int) -> int:
-    """Largest divisor of `total` that is <= target (halving search from
-    target, then linear fallback — totals are products of small powers)."""
+    """Largest divisor of `total` that is <= target (linear scan down from
+    target; token counts are products of small factors, so the scan is
+    short in practice — runs at trace time only)."""
     g = min(total, target)
     while g > 1 and total % g != 0:
         g -= 1
@@ -114,8 +115,10 @@ class MoEMLP(nn.Module):
             "necd,nsec->nsd", expert_out, comb.astype(self.dtype))
 
         # Switch-style load-balance loss: E * sum_e f_e * p_e where f is
-        # the dispatched fraction and p the mean router probability.
-        frac = (onehot * within[..., None]).sum(2).mean((0, 1))   # [E]
+        # the RAW router-assignment fraction (no capacity mask — an
+        # overloaded expert's fraction must not be clipped exactly when
+        # imbalance is worst) and p the mean router probability.
+        frac = onehot.sum(2).mean((0, 1))                         # [E]
         mean_p = probs.mean((0, 1))
         aux = E * jnp.sum(frac * mean_p)
         return y.reshape(B, S, D).astype(x.dtype), aux

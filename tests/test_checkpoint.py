@@ -138,3 +138,100 @@ def test_step_cadence_pruned_and_exclusive(devices8, tmp_path):
     names = sorted(_os.listdir(tmp_path / "ck"))
     assert names == ["step=3", "step=4"]  # pruned to 2, no epoch dirs
     assert cb.best_model_path.endswith("step=4")
+
+
+def test_mid_epoch_resume_replays_rest_of_epoch(tmp_path):
+    """A checkpoint saved mid-epoch (step cadence) resumes the SAME epoch
+    at the saved batch offset — the remainder of the epoch is trained, not
+    silently skipped."""
+    data = random_dataset(n=128)  # 4 batches/epoch at bs=32
+
+    module = BoringModel(lr=0.05)
+    trainer = Trainer(strategy=SingleDevice(), max_epochs=1, max_steps=2,
+                      enable_checkpointing=False, enable_progress_bar=False,
+                      default_root_dir=str(tmp_path / "a"), seed=7)
+    trainer.fit(module, DataLoader(data, batch_size=32, shuffle=True, seed=3))
+    assert trainer.global_step == 2  # stopped mid-epoch (2 of 4 batches)
+    ckpt = trainer.save_checkpoint(str(tmp_path / "mid"))
+
+    module_b = BoringModel(lr=0.05)
+    trainer_b = Trainer(strategy=SingleDevice(), max_epochs=2,
+                        enable_checkpointing=False, enable_progress_bar=False,
+                        default_root_dir=str(tmp_path / "b"), seed=7)
+    trainer_b.fit(module_b,
+                  DataLoader(data, batch_size=32, shuffle=True, seed=3),
+                  ckpt_path=ckpt)
+    # same-epoch resume: 2 remaining batches of epoch 0 + 4 of epoch 1
+    assert trainer_b.current_epoch == 1
+    assert trainer_b.global_step == 8
+
+
+def test_epoch_boundary_resume_advances_epoch(tmp_path):
+    """A checkpoint saved at an epoch boundary resumes at the NEXT epoch."""
+    data = random_dataset(n=128)
+    module = BoringModel(lr=0.05)
+    trainer = Trainer(strategy=SingleDevice(), max_epochs=1,
+                      enable_checkpointing=False, enable_progress_bar=False,
+                      default_root_dir=str(tmp_path / "a"), seed=7)
+    trainer.fit(module, DataLoader(data, batch_size=32))
+    ckpt = trainer.save_checkpoint(str(tmp_path / "end"))
+
+    module_b = BoringModel(lr=0.05)
+    trainer_b = Trainer(strategy=SingleDevice(), max_epochs=2,
+                        enable_checkpointing=False, enable_progress_bar=False,
+                        default_root_dir=str(tmp_path / "b"), seed=7)
+    trainer_b.fit(module_b, DataLoader(data, batch_size=32), ckpt_path=ckpt)
+    assert trainer_b.global_step == 8  # exactly one more epoch
+
+
+def test_async_meta_deferred_until_finalized(tmp_path):
+    """block=False must not drop meta.json (the completeness marker) until
+    the state write has finalized — wait_for_checkpoints() writes it."""
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.checkpoint import wait_for_checkpoints
+    from ray_lightning_tpu.checkpoint.io import read_meta
+
+    path = str(tmp_path / "ck")
+    save_checkpoint(path, {"w": jnp.ones((4,))}, {"epoch": 3}, block=False)
+    assert not os.path.exists(os.path.join(path, "meta.json"))
+    wait_for_checkpoints()
+    assert os.path.exists(os.path.join(path, "meta.json"))
+    assert read_meta(path)["epoch"] == 3
+
+
+def test_async_save_with_top_k_prune(tmp_path):
+    """Async step-cadence saves with save_top_k pruning: pruned dirs stay
+    deleted (no resurrection by background finalize), kept dirs have
+    meta.json, and fit succeeds."""
+    data = random_dataset(n=256)  # 8 steps at bs=32
+    cb = ModelCheckpoint(dirpath=str(tmp_path / "ck"),
+                         every_n_train_steps=1, async_save=True,
+                         save_top_k=1)
+    trainer = Trainer(strategy=SingleDevice(), max_epochs=1,
+                      callbacks=[cb], default_root_dir=str(tmp_path),
+                      enable_progress_bar=False)
+    trainer.fit(BoringModel(), DataLoader(data, batch_size=32))
+    kept = sorted(os.listdir(tmp_path / "ck"))
+    assert kept == ["step=8"], kept
+    assert os.path.exists(tmp_path / "ck" / "step=8" / "meta.json")
+
+
+def test_monitored_absent_keeps_tracking(tmp_path):
+    """A validation epoch without the monitored metric must not drop the
+    existing checkpoint's save_top_k tracking entry."""
+    cb = ModelCheckpoint(dirpath=str(tmp_path / "ck"), monitor="val_loss",
+                         save_top_k=1, filename="best")
+    cb.best_model_path = str(tmp_path / "ck" / "best")
+    cb.best_model_score = 1.0
+    cb._saved = [(1.0, cb.best_model_path)]
+
+    class _T:
+        current_epoch = 1
+        every_n_epochs = 1
+        global_step = 4
+        val_check_interval = None
+        default_root_dir = str(tmp_path)
+
+    cb._maybe_save(_T(), None, {"other": 2.0})  # metric absent: no save
+    assert cb._saved == [(1.0, cb.best_model_path)]
