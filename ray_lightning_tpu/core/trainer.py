@@ -295,9 +295,14 @@ class Trainer:
     def _run_eval_epoch(
         self, loader, limit: Optional[int] = None, sanity: bool = False
     ) -> Dict[str, float]:
+        """Eval totals accumulate ON DEVICE (batch-size-weighted sums of
+        the replicated step metrics — each += is a tiny async dispatch, no
+        transfer) and are fetched with ONE host sync at epoch end; a
+        per-batch `device_get` would stall the pipeline once per batch,
+        ruinous for real validation sets at 8B scale."""
         if hasattr(loader, "set_epoch"):
             loader.set_epoch(self.current_epoch)
-        totals: Dict[str, float] = {}
+        totals: Dict[str, Any] = {}
         weights = 0.0
         for batch_idx, batch in enumerate(loader):
             if limit is not None and batch_idx >= limit:
@@ -305,13 +310,17 @@ class Trainer:
             batch = self._cast(batch)
             bs = _leading_dim(batch) or 1
             device_batch = self.strategy.shard_batch(batch)
-            metrics = _to_host(self._eval_step(self.state.params, device_batch))
+            metrics = self._eval_step(self.state.params, device_batch)
             for k, v in metrics.items():
-                totals[k] = totals.get(k, 0.0) + float(v) * bs
+                # accumulate in f32 — a bf16 step metric summed over
+                # hundreds of batches would round away the increments
+                scaled = jnp.asarray(v).astype(jnp.float32) * bs
+                totals[k] = totals[k] + scaled if k in totals else scaled
             weights += bs
         if sanity or weights == 0:
             return {}
-        return {k: v / weights for k, v in totals.items()}
+        host = _to_host(totals)
+        return {k: float(v) / weights for k, v in host.items()}
 
     # ------------------------------------------------------- validate & co.
 
@@ -351,7 +360,7 @@ class Trainer:
         for batch in dataloaders:
             batch = self._cast(batch)
             device_batch = self.strategy.shard_batch(batch)
-            outs.append(_to_host(step(self.state.params, device_batch)))
+            outs.append(_gather_out(step(self.state.params, device_batch)))
         return outs
 
     # --------------------------------------------------------- checkpoints
@@ -618,6 +627,19 @@ class _ProfilerCtx:
     def __exit__(self, *exc):
         jax.profiler.stop_trace()
         return False
+
+
+def _gather_out(tree) -> Any:
+    """Host copy of a possibly-multi-process prediction output: batch-axis-
+    sharded arrays are not fully addressable on any one process, so gather
+    globally first (every rank sees the full output; rank 0's is the
+    conventional carrier through run_distributed)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        tree = multihost_utils.process_allgather(tree, tiled=True)
+        return jax.tree.map(np.asarray, tree)
+    return _to_host(tree)
 
 
 def _to_host(tree) -> Any:

@@ -12,9 +12,23 @@ from ray_lightning_tpu.runtime.group import (
     WorkerError,
     WorkerGroup,
     find_free_port,
+    routable_ip,
 )
-from ray_lightning_tpu.runtime.fit import FitResult, fit_distributed
+from ray_lightning_tpu.runtime.fit import (
+    FitResult,
+    fit_distributed,
+    predict_distributed,
+    run_distributed,
+    test_distributed,
+    validate_distributed,
+)
 from ray_lightning_tpu.runtime.launch import launch, launch_cpu_spmd
+from ray_lightning_tpu.runtime.transport import (
+    LocalTransport,
+    LoopbackTransport,
+    SSHTransport,
+    Transport,
+)
 from ray_lightning_tpu.runtime.session import (
     get_actor_rank,
     get_session,
@@ -28,12 +42,21 @@ from ray_lightning_tpu.runtime.session import (
 __all__ = [
     "FitResult",
     "fit_distributed",
+    "run_distributed",
+    "validate_distributed",
+    "test_distributed",
+    "predict_distributed",
     "TpuExecutor",
     "WorkerError",
     "WorkerGroup",
     "find_free_port",
+    "routable_ip",
     "launch",
     "launch_cpu_spmd",
+    "LocalTransport",
+    "LoopbackTransport",
+    "SSHTransport",
+    "Transport",
     "get_actor_rank",
     "get_session",
     "get_world_size",

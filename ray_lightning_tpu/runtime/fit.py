@@ -1,12 +1,17 @@
-"""Distributed fit: the full state round-trip over the runtime substrate.
+"""Distributed jobs: the full state round-trip over the runtime substrate.
 
 Rebuild of the reference's core protocol (reference ray_ddp.py:143-199):
-driver ships the training job to N workers, workers run the fit loop
-jointly, and rank 0's results / trained weights / best_model_path come
-back and are patched into the DRIVER's objects — after `fit_distributed`
-returns, the caller's module object holds trained weights (C5 of SURVEY
-§7.1; reference ray_ddp.py:186-193 `load_state_dict` + best_model_path
-patch-in).
+driver ships a job to N workers, workers run it jointly, and rank 0's
+results / trained weights / best_model_path come back and are patched
+into the DRIVER's objects — after `fit_distributed` returns, the caller's
+module object holds trained weights (C5 of SURVEY §7.1; reference
+ray_ddp.py:186-193 `load_state_dict` + best_model_path patch-in).
+
+The reference's plugin hosts every Trainer entrypoint, not just fit — its
+canonical test matrix is train/load/predict through the plugin (reference
+tests/test_ddp.py:79-113). Here the same round-trip protocol carries a
+job *kind*: ``fit | validate | test | predict``, with eval metrics and
+predictions returning from rank 0.
 
 Differences from the reference, by design (SURVEY §7.4 hard parts #1-#3):
   * the workers are H host-processes jointly executing ONE SPMD program
@@ -21,12 +26,15 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from ray_lightning_tpu.runtime.launch import launch
+from ray_lightning_tpu.runtime.transport import Transport
 from ray_lightning_tpu.utils import get_logger
 
 log = get_logger(__name__)
+
+_KINDS = ("fit", "validate", "test", "predict")
 
 
 @dataclasses.dataclass
@@ -40,35 +48,72 @@ class FitResult:
     best_model_path: Optional[str]
     state_dict: Optional[Any]  # host numpy pytree, or None if too large
     checkpoint_path: Optional[str]
+    predictions: Optional[List[Any]] = None  # kind="predict" only
 
 
-def _fit_remote(
+def _job_remote(
+    kind: str,
     module_factory: Callable[[], Any],
     trainer_factory: Callable[[], Any],
     data_factory: Callable[[], Any],
     return_weights: bool,
     final_ckpt_dir: Optional[str],
+    ckpt_path: Optional[str],
 ):
     """Runs in EVERY worker process after jax.distributed init (the analog
-    of train_remote, reference ray_ddp.py:217-246)."""
+    of train_remote, reference ray_ddp.py:217-246 — generalized to the
+    reference protocol's full train/validate/test/predict surface)."""
     import jax
     import numpy as np
 
     module = module_factory()
     trainer = trainer_factory()
     data = data_factory()
+    rank = jax.process_index()
+
+    if kind != "fit":
+        # Eval-family jobs: weights come from the factory or a checkpoint
+        # (the reference's load-then-predict leg, tests/test_ddp.py:79-113).
+        # load_checkpoint gathers to host — the small/medium-model path;
+        # resume-at-scale goes through fit's sharded restore instead.
+        if ckpt_path is not None:
+            from ray_lightning_tpu.checkpoint import load_checkpoint
+
+            ckpt = load_checkpoint(ckpt_path)
+            module.setup()
+            module.params = ckpt["params"]
+            module.on_load_checkpoint(ckpt)
+        runner = {
+            "validate": trainer.validate,
+            "test": trainer.test,
+            "predict": trainer.predict,
+        }[kind]
+        out = runner(module, data)
+        if rank != 0:
+            return None
+        if kind == "predict":
+            return FitResult(
+                metrics=dict(trainer.callback_metrics),
+                best_model_path=None, state_dict=None,
+                checkpoint_path=None,
+                predictions=jax.tree.map(np.asarray, out),
+            )
+        return FitResult(
+            metrics=dict(out), best_model_path=None,
+            state_dict=None, checkpoint_path=None,
+        )
+
     if not isinstance(data, tuple):
         data = (data, None)
     train_data, val_data = data
-    trainer.fit(module, train_data, val_data)
+    trainer.fit(module, train_data, val_data, ckpt_path=ckpt_path)
 
-    rank = jax.process_index()
-    ckpt_path = None
+    out_ckpt = None
     if final_ckpt_dir is not None:
         # Sharded write: every process writes its addressable shards
         # (orbax handles the coordination); replaces the reference's
         # driver-side single-file checkpoint.
-        ckpt_path = trainer.save_checkpoint(
+        out_ckpt = trainer.save_checkpoint(
             os.path.join(final_ckpt_dir, "final")
         )
     state_dict = None
@@ -89,18 +134,20 @@ def _fit_remote(
             metrics=dict(trainer.callback_metrics),
             best_model_path=best,
             state_dict=state_dict,
-            checkpoint_path=ckpt_path,
+            checkpoint_path=out_ckpt,
         )
     return None
 
 
-def fit_distributed(
+def run_distributed(
+    kind: str,
     module_factory: Callable[[], Any],
     trainer_factory: Callable[[], Any],
     data_factory: Callable[[], Any],
     num_processes: int,
     *,
     module: Optional[Any] = None,
+    ckpt_path: Optional[str] = None,
     platform: Optional[str] = None,
     num_cpu_devices_per_process: Optional[int] = None,
     env: Optional[Dict[str, str]] = None,
@@ -110,20 +157,30 @@ def fit_distributed(
     final_ckpt_dir: Optional[str] = None,
     timeout: Optional[float] = None,
     log_dir: Optional[str] = None,
+    hosts: Optional[Sequence[str]] = None,
+    transport: Optional[Transport] = None,
 ) -> FitResult:
-    """Run a Trainer.fit as one multi-process SPMD job; return rank 0's
-    results and (optionally) patch trained weights into ``module``.
+    """Run one Trainer job (`fit|validate|test|predict`) as a multi-process
+    SPMD program; return rank 0's results.
 
     The three factories are shipped by value (cloudpickle), replacing the
     reference's "model must be pickleable" contract (README.md:119) with
     the JAX-friendly split of static definition vs array state
-    (SURVEY §7.4 hard part #3).
+    (SURVEY §7.4 hard part #3). For fit, ``data_factory`` returns a train
+    loader or a (train, val) tuple; for the eval kinds it returns that
+    kind's loader. ``ckpt_path`` resumes a fit, or supplies the weights
+    for an eval-family job (the reference's train→load→predict matrix).
+
+    ``hosts``/``transport`` place workers on cluster hosts (see
+    runtime/transport.py); default is local subprocesses.
     """
+    if kind not in _KINDS:
+        raise ValueError(f"kind must be one of {_KINDS}, got {kind!r}")
     results: List[Any] = launch(
-        _fit_remote,
+        _job_remote,
         num_processes,
-        args=(module_factory, trainer_factory, data_factory,
-              return_weights, final_ckpt_dir),
+        args=(kind, module_factory, trainer_factory, data_factory,
+              return_weights, final_ckpt_dir, ckpt_path),
         platform=platform,
         num_cpu_devices_per_process=num_cpu_devices_per_process,
         env=env,
@@ -131,15 +188,61 @@ def fit_distributed(
         on_queue_item=on_queue_item,
         timeout=timeout,
         log_dir=log_dir,
+        hosts=hosts,
+        transport=transport,
     )
     result = results[0]
     assert isinstance(result, FitResult), (
         f"rank 0 returned {type(result)}; expected FitResult"
     )
-    if module is not None and result.state_dict is not None:
+    if kind == "fit" and module is not None and result.state_dict is not None:
         # reference ray_ddp.py:190: driver model gets the trained weights,
         # ready for local inference.
         if hasattr(module, "setup"):
             module.setup()
         module.params = result.state_dict
     return result
+
+
+def fit_distributed(
+    module_factory: Callable[[], Any],
+    trainer_factory: Callable[[], Any],
+    data_factory: Callable[[], Any],
+    num_processes: int,
+    **kw,
+) -> FitResult:
+    """Distributed ``Trainer.fit`` round-trip (reference ray_ddp.py:143-199).
+    See `run_distributed` for the full parameter surface."""
+    return run_distributed(
+        "fit", module_factory, trainer_factory, data_factory,
+        num_processes, **kw,
+    )
+
+
+def validate_distributed(module_factory, trainer_factory, data_factory,
+                         num_processes, **kw) -> FitResult:
+    """Distributed ``Trainer.validate``; metrics return from rank 0."""
+    return run_distributed(
+        "validate", module_factory, trainer_factory, data_factory,
+        num_processes, **kw,
+    )
+
+
+def test_distributed(module_factory, trainer_factory, data_factory,
+                     num_processes, **kw) -> FitResult:
+    """Distributed ``Trainer.test``; metrics return from rank 0."""
+    return run_distributed(
+        "test", module_factory, trainer_factory, data_factory,
+        num_processes, **kw,
+    )
+
+
+def predict_distributed(module_factory, trainer_factory, data_factory,
+                        num_processes, **kw) -> FitResult:
+    """Distributed ``Trainer.predict``; the globally-gathered predictions
+    return from rank 0 in ``result.predictions`` (reference predict leg of
+    tests/test_ddp.py:79-113)."""
+    return run_distributed(
+        "predict", module_factory, trainer_factory, data_factory,
+        num_processes, **kw,
+    )

@@ -24,18 +24,17 @@ import os
 import secrets
 import socket
 import subprocess
-import sys
+import threading
 import time
 from multiprocessing.connection import Connection, Listener, wait as conn_wait
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import cloudpickle
 
+from ray_lightning_tpu.runtime.transport import LocalTransport, Transport
 from ray_lightning_tpu.utils import get_logger
 
 log = get_logger(__name__)
-
-_WORKER_PATH = os.path.join(os.path.dirname(__file__), "worker.py")
 
 
 def find_free_port(host: str = "127.0.0.1") -> int:
@@ -46,6 +45,53 @@ def find_free_port(host: str = "127.0.0.1") -> int:
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+def routable_ip() -> str:
+    """This machine's address as other hosts see it (reference analog:
+    ``get_node_ip``, ray_ddp.py:33-35). UDP-connect trick — no packet is
+    sent; falls back to loopback on isolated boxes."""
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 80))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"
+
+
+def _accept_with_deadline(listener: Listener, timeout: float):
+    """``listener.accept()`` bounded by ``timeout``; returns None on expiry.
+
+    accept() is unboundedly blocking — not just the socket accept but the
+    authkey challenge that follows on the accepted connection, which a
+    stalled/hostile peer (possible once the listener binds 0.0.0.0 for
+    remote transports) could hold open forever. Run it on a daemon thread
+    and abandon it at the deadline; an abandoned thread parked on a dead
+    connection costs nothing and dies with the process.
+    """
+    box: Dict[str, Any] = {}
+    done = threading.Event()
+
+    def _run():
+        try:
+            box["conn"] = listener.accept()
+        except Exception as exc:  # noqa: BLE001 — relayed to the caller
+            box["err"] = exc
+        done.set()
+
+    threading.Thread(target=_run, daemon=True).start()
+    if not done.wait(timeout):
+        return None
+    if "err" in box:
+        if isinstance(box["err"], (OSError, EOFError)):
+            # auth failure / scanner disconnect: treat as "nobody valid
+            # connected" and let the caller's deadline loop continue
+            log.warning("listener accept failed: %s", box["err"])
+            return None
+        raise box["err"]
+    return box["conn"]
 
 
 class WorkerError(RuntimeError):
@@ -62,13 +108,15 @@ class TpuExecutor:
     """One remote worker process (reference RayExecutor, ray_ddp.py:17-39)."""
 
     def __init__(self, rank: int, world: int, proc: subprocess.Popen,
-                 conn: Connection, info: Dict[str, Any], log_path: str):
+                 conn: Connection, info: Dict[str, Any], log_path: str,
+                 host: Optional[str] = None):
         self.rank = rank
         self.world = world
         self.proc = proc
         self.conn = conn
         self.info = info
         self.log_path = log_path
+        self.host = host  # placement target (None = driver machine)
         self._next_tid = 0
 
     # -- RayExecutor API parity -------------------------------------------
@@ -121,12 +169,22 @@ class WorkerGroup:
 
     def __init__(
         self,
-        num_workers: int,
+        num_workers: Optional[int] = None,
         env: Optional[Dict[str, str]] = None,
         init_hook: Optional[Callable[[], None]] = None,
         log_dir: Optional[str] = None,
         start_timeout: float = 120.0,
+        hosts: Optional[Sequence[str]] = None,
+        transport: Optional[Transport] = None,
+        advertise_host: Optional[str] = None,
     ):
+        """``hosts`` + a remote ``transport`` place one worker per host
+        (reference ray_ddp.py:106-119's cluster-wide actor placement; on a
+        TPU pod: one entry per host VM). Without them, workers are local
+        subprocesses. ``advertise_host`` overrides the driver address
+        workers dial back to (defaults to the routable IP when remote)."""
+        if num_workers is None:
+            num_workers = len(hosts) if hosts else 1
         if num_workers < 1:
             raise ValueError("num_workers must be >= 1")
         self.num_workers = num_workers
@@ -136,64 +194,91 @@ class WorkerGroup:
             os.getcwd(), "rlt_logs", "workers"
         )
         self.start_timeout = start_timeout
+        self.hosts = list(hosts) if hosts else None
+        self.transport = transport or LocalTransport()
+        if self.hosts and not self.transport.is_remote:
+            # Without this, hosts=[...] + the default transport would
+            # silently run every worker on the driver machine while
+            # executor.host reports the requested (never-used) hostnames.
+            raise ValueError(
+                "hosts= requires a remote transport (e.g. SSHTransport); "
+                f"got {type(self.transport).__name__}"
+            )
+        self.advertise_host = advertise_host
         self.executors: List[TpuExecutor] = []
         self._listener: Optional[Listener] = None
         self._queue_items: List[Any] = []
+
+    @property
+    def is_remote(self) -> bool:
+        return self.transport.is_remote
+
+    def _worker_host(self, rank: int) -> Optional[str]:
+        if not self.hosts:
+            return None
+        return self.hosts[rank % len(self.hosts)]
 
     # ------------------------------------------------------------- launch
     def start(self) -> "WorkerGroup":
         os.makedirs(self.log_dir, exist_ok=True)
         authkey = secrets.token_bytes(32)
-        self._listener = Listener(("127.0.0.1", 0), authkey=authkey)
-        host, port = self._listener.address
+        # Remote workers must reach the driver: bind all interfaces and
+        # advertise a routable address (the reference's Listener equivalent
+        # was Ray's GCS, reachable cluster-wide by construction; loopback —
+        # the round-1/2 limitation — only ever worked on one machine).
+        bind_host = "0.0.0.0" if self.is_remote else "127.0.0.1"
+        self._listener = Listener((bind_host, 0), authkey=authkey)
+        port = self._listener.address[1]
+        connect_host = self.advertise_host or (
+            routable_ip() if self.is_remote else "127.0.0.1"
+        )
         procs: Dict[int, subprocess.Popen] = {}
         logs: Dict[int, str] = {}
-        repo_root = os.path.dirname(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        )
-        for rank in range(self.num_workers):
-            wenv = dict(os.environ)
-            wenv.update(self.env)
-            wenv["RLT_WORKER_AUTHKEY"] = authkey.hex()
-            # Make the package importable in the worker no matter where the
-            # driver was launched from (env bootstrap, C7 of SURVEY §7.1).
-            wenv["PYTHONPATH"] = (
-                repo_root + os.pathsep + wenv.get("PYTHONPATH", "")
-            )
-            log_path = os.path.join(self.log_dir, f"worker-{rank}.log")
-            logs[rank] = log_path
-            logf = open(log_path, "w")
-            procs[rank] = subprocess.Popen(
-                [sys.executable, "-u", _WORKER_PATH,
-                 host, str(port), str(rank), str(self.num_workers)],
-                env=wenv, stdout=logf, stderr=subprocess.STDOUT,
-            )
-            logf.close()
+        try:
+            for rank in range(self.num_workers):
+                log_path = os.path.join(self.log_dir, f"worker-{rank}.log")
+                logs[rank] = log_path
+                procs[rank] = self.transport.spawn(
+                    host=self._worker_host(rank),
+                    connect=(connect_host, port, rank, self.num_workers),
+                    env=self.env,
+                    authkey_hex=authkey.hex(),
+                    log_path=log_path,
+                )
+        except Exception:
+            # A failed spawn (missing ssh binary, dead host) must not leak
+            # the workers already started on other hosts or the listener.
+            self._abort_start(procs, logs)
+            raise
         # Accept hellos; connections arrive in arbitrary order — the hello
         # message carries the rank (cf. reference get_local_ranks building
         # the rank map driver-side, ray_ddp.py:130-141).
         by_rank: Dict[int, TpuExecutor] = {}
         deadline = time.monotonic() + self.start_timeout
         for _ in range(self.num_workers):
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
+            conn = None
+            while conn is None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self._abort_start(procs, logs)
+                    raise TimeoutError(
+                        "workers did not all connect within "
+                        f"{self.start_timeout}s"
+                    )
+                conn = _accept_with_deadline(self._listener, remaining)
+            # Bound the hello read too: with the listener on 0.0.0.0 a
+            # stray connection that never speaks must not wedge start().
+            if not conn.poll(max(0.0, deadline - time.monotonic())):
                 self._abort_start(procs, logs)
                 raise TimeoutError(
-                    f"workers did not all connect within {self.start_timeout}s"
+                    "worker connected but sent no hello within "
+                    f"{self.start_timeout}s"
                 )
-            # Listener.accept has no timeout; poll the underlying socket.
-            self._listener._listener._socket.settimeout(remaining)
-            try:
-                conn = self._listener.accept()
-            except socket.timeout:
-                self._abort_start(procs, logs)
-                raise TimeoutError(
-                    f"workers did not all connect within {self.start_timeout}s"
-                ) from None
             cmd, rank, info = conn.recv()
             assert cmd == "hello", cmd
             by_rank[rank] = TpuExecutor(
-                rank, self.num_workers, procs[rank], conn, info, logs[rank]
+                rank, self.num_workers, procs[rank], conn, info, logs[rank],
+                host=self._worker_host(rank),
             )
         self.executors = [by_rank[r] for r in range(self.num_workers)]
         if self.init_hook is not None:
@@ -215,6 +300,9 @@ class WorkerGroup:
                 except OSError:
                     pass
             p.kill()
+        if self._listener is not None:
+            self._listener.close()
+            self._listener = None
         if tails:
             log.error("worker startup failure:\n%s", "\n".join(tails))
 
@@ -278,6 +366,49 @@ class WorkerGroup:
                 self._dispatch(msg, ex, tids, results, done, on_queue_item)
         self.drain_queue(on_queue_item)
         return [results[r] for r in range(self.num_workers)]
+
+    def run_single(
+        self, rank: int, fn: Callable, *args,
+        timeout: Optional[float] = None, **kwargs,
+    ) -> Any:
+        """Execute ``fn`` on ONE rank and return its result (the analog of
+        the reference's targeted ``worker.execute.remote`` calls — e.g. the
+        MASTER_PORT probe on worker 0, ray_ddp.py:152-156)."""
+        assert self.executors, "call start() first"
+        ex = self.executors[rank]
+        tid = ex.execute_async(fn, *args, **kwargs)
+        deadline = (
+            (time.monotonic() + timeout) if timeout is not None else None
+        )
+        while True:
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"rank {rank} still pending")
+            if not ex.conn.poll(1.0):
+                if not ex.alive():
+                    raise WorkerError(
+                        ex.rank,
+                        f"worker process exited rc={ex.proc.returncode} "
+                        "without returning a result",
+                        ex.log_tail(),
+                    )
+                continue
+            try:
+                msg = ex.conn.recv()
+            except EOFError:
+                raise WorkerError(
+                    ex.rank, "worker process died (EOF on channel)",
+                    ex.log_tail(),
+                ) from None
+            cmd = msg[0]
+            if cmd == "result" and msg[1] == tid:
+                return cloudpickle.loads(msg[2])
+            elif cmd == "error":
+                if msg[1] == tid:
+                    raise WorkerError(ex.rank, msg[2], ex.log_tail())
+                log.warning("dropping stale error from rank %d", ex.rank)
+            elif cmd == "queue":
+                qrank, item = cloudpickle.loads(msg[1])
+                self._handle_queue_item(qrank, item, None)
 
     def _dispatch(self, msg, ex, tids, results, done, on_queue_item) -> None:
         cmd = msg[0]

@@ -22,9 +22,26 @@ import os
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from ray_lightning_tpu.runtime.group import WorkerGroup, find_free_port
+from ray_lightning_tpu.runtime.transport import Transport
 from ray_lightning_tpu.utils import get_logger
 
 log = get_logger(__name__)
+
+
+def _probe_coordinator_port():
+    """Runs ON worker 0: find a port free on all interfaces of ITS host.
+
+    Self-contained (stdlib only, shipped by value) so it needs no package
+    import on the remote side. Reference analog: find_free_port executed on
+    worker 0 for MASTER_PORT (ray_ddp.py:154-156).
+    """
+    import socket
+
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
 
 
 def _spmd_main(
@@ -79,6 +96,9 @@ def launch(
     per_rank_args: Optional[Sequence[tuple]] = None,
     log_dir: Optional[str] = None,
     timeout: Optional[float] = None,
+    hosts: Optional[Sequence[str]] = None,
+    transport: Optional[Transport] = None,
+    coordinator_address: Optional[str] = None,
 ) -> List[Any]:
     """Run ``fn`` on ``num_processes`` host-processes as one SPMD job.
 
@@ -90,16 +110,35 @@ def launch(
     ``fn`` runs AFTER jax.distributed.initialize, so inside it
     ``jax.devices()`` is the global device set and a ``Mesh`` built over it
     spans all processes.
+
+    ``hosts`` + a remote ``transport`` (e.g. SSHTransport) place one
+    process per cluster host — the cross-host path. The jax coordinator
+    then binds on WORKER 0's host at its routable IP (the reference's
+    MASTER_ADDR ← worker0 IP, MASTER_PORT ← free port dance,
+    ray_ddp.py:152-156); locally it stays on loopback. Override with an
+    explicit ``coordinator_address`` when pod metadata supplies one.
     """
-    coordinator = f"127.0.0.1:{find_free_port()}"
     group = WorkerGroup(
         num_workers=num_processes,
         env=env,
         init_hook=init_hook,
         log_dir=log_dir,
+        hosts=hosts,
+        transport=transport,
     )
     group.start()
     try:
+        if coordinator_address is not None:
+            coordinator = coordinator_address
+        elif group.is_remote and num_processes > 1:
+            # rank 0 hosts the coordination service: its routable IP (from
+            # the hello) + a port probed free on its own interfaces.
+            host0 = group.executors[0].get_node_ip()
+            port0 = group.run_single(0, _probe_coordinator_port, timeout=60)
+            coordinator = f"{host0}:{port0}"
+            log.info("jax coordinator at %s (worker 0)", coordinator)
+        else:
+            coordinator = f"127.0.0.1:{find_free_port()}"
         launch_args = [
             (fn, tuple(args) + (per_rank_args[r] if per_rank_args else ()),
              dict(kwargs or {}), r, num_processes, coordinator, platform,

@@ -1,0 +1,215 @@
+"""Worker-process transports: HOW the driver starts a worker on a host.
+
+The reference placed workers on arbitrary cluster nodes through Ray's
+actor scheduler (``RayExecutor.options(...).remote()``, reference
+ray_ddp.py:106-119) and bootstrapped rendezvous across them with env-var
+injection (:158-164). The rebuild makes that placement step a pluggable
+*transport*: the WorkerGroup decides WHAT to run (the worker loop, its
+rank, the driver's listener address) and the transport decides how a
+process running it appears on ``host``.
+
+  * LocalTransport — subprocess on the driver machine (dev box, CI, and
+    single-host TPU VMs).
+  * SSHTransport   — ``ssh host python -u -`` with the worker program
+    piped over stdin: nothing needs to be pre-staged on the remote host
+    for the worker *loop* itself (user closures still import
+    ``ray_lightning_tpu``, so the package must be installed remotely),
+    and the connection authkey travels over the encrypted stdin, never
+    on a command line. On GCP TPU pods, point ``ssh`` at
+    ``gcloud compute tpus tpu-vm ssh``-compatible wrappers or plain ssh
+    to the per-host VM IPs.
+  * LoopbackTransport — the SSH bootstrap path with the ssh prefix
+    removed: runs locally but crosses the same "remote" semantics
+    (scrubbed environment, stdin bootstrap, routable listener). This is
+    the test seam for the cross-host code path.
+
+Every transport returns a ``subprocess.Popen``-compatible handle
+(poll/kill/wait/returncode); for SSH the handle is the local ssh client
+process — killing it drops the stdin/stdout pipes, which the worker
+observes as EOF and the driver's pump reports fail-fast.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Dict, Optional, Sequence
+
+_WORKER_PATH = os.path.join(os.path.dirname(__file__), "worker.py")
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+class Transport:
+    """Spawn one worker process on ``host``.
+
+    ``is_remote`` drives address resolution in WorkerGroup/launch: remote
+    transports get a listener bound on all interfaces and a routable
+    advertise address; local ones stay on loopback.
+    """
+
+    is_remote = False
+
+    def spawn(
+        self,
+        *,
+        host: Optional[str],
+        connect: tuple,  # (driver_host, driver_port, rank, world)
+        env: Dict[str, str],
+        authkey_hex: str,
+        log_path: str,
+    ) -> subprocess.Popen:
+        raise NotImplementedError
+
+
+class LocalTransport(Transport):
+    """Workers as plain subprocesses of the driver (the round-1 behavior)."""
+
+    def spawn(self, *, host, connect, env, authkey_hex, log_path):
+        driver_host, port, rank, world = connect
+        wenv = dict(os.environ)
+        wenv.update(env)
+        wenv["RLT_WORKER_AUTHKEY"] = authkey_hex
+        # Make the package importable in the worker no matter where the
+        # driver was launched from (env bootstrap, C7 of SURVEY §7.1).
+        wenv["PYTHONPATH"] = (
+            _REPO_ROOT + os.pathsep + wenv.get("PYTHONPATH", "")
+        )
+        logf = open(log_path, "w")
+        try:
+            return subprocess.Popen(
+                [sys.executable, "-u", _WORKER_PATH,
+                 driver_host, str(port), str(rank), str(world)],
+                env=wenv, stdout=logf, stderr=subprocess.STDOUT,
+            )
+        finally:
+            logf.close()
+
+
+def _bootstrap_source(
+    connect: tuple,
+    env: Dict[str, str],
+    authkey_hex: str,
+    pythonpath: Sequence[str],
+) -> str:
+    """Self-contained worker program for ``python -u -`` on a remote host.
+
+    Preamble injects env + sys.argv, then the verbatim worker.py source
+    runs as __main__ (stdin programs are __main__, so its entrypoint
+    guard fires). Secrets ride the (encrypted) stdin, not argv or the
+    remote process environment listing... env vars ARE process env, but
+    they were never on a command line where `ps` could see them.
+    """
+    driver_host, port, rank, world = connect
+    wenv = dict(env)
+    wenv["RLT_WORKER_AUTHKEY"] = authkey_hex
+    with open(_WORKER_PATH, "r") as f:
+        worker_src = f.read()
+    preamble = (
+        "import os, sys\n"
+        f"os.environ.update({wenv!r})\n"
+        f"_pp = {list(pythonpath)!r}\n"
+        "if _pp:\n"
+        "    os.environ['PYTHONPATH'] = os.pathsep.join(\n"
+        "        _pp + ([os.environ['PYTHONPATH']]\n"
+        "               if os.environ.get('PYTHONPATH') else []))\n"
+        "    sys.path[:0] = _pp\n"
+        f"sys.argv = ['worker.py', {driver_host!r}, {str(port)!r}, "
+        f"{str(rank)!r}, {str(world)!r}]\n"
+    )
+    return preamble + worker_src
+
+
+class SSHTransport(Transport):
+    """Start workers on remote hosts over ssh.
+
+    Parameters
+    ----------
+    ssh: argv prefix invoked as ``<ssh...> <host> -- <python> -u -``.
+        Default plain ssh with BatchMode (no password prompts).
+    remote_python: interpreter on the remote host.
+    pythonpath: remote directories prepended to sys.path/PYTHONPATH in
+        the worker (where ``ray_lightning_tpu`` + deps live, if not
+        installed into the interpreter).
+    env: transport-level env applied to every worker, merged under the
+        group's per-launch env.
+
+    v5p-pod recipe (one worker per host VM)::
+
+        transport = SSHTransport(remote_python="python3")
+        group = WorkerGroup(hosts=[ip0, ip1, ...], transport=transport)
+    """
+
+    is_remote = True
+
+    def __init__(
+        self,
+        ssh: Sequence[str] = ("ssh", "-o", "BatchMode=yes"),
+        remote_python: str = "python3",
+        pythonpath: Sequence[str] = (),
+        env: Optional[Dict[str, str]] = None,
+    ):
+        self.ssh = list(ssh)
+        self.remote_python = remote_python
+        self.pythonpath = list(pythonpath)
+        self.env = dict(env or {})
+
+    def _command(self, host: Optional[str]) -> list:
+        if not host:
+            raise ValueError("SSHTransport needs a host per worker "
+                             "(pass hosts=[...] to WorkerGroup)")
+        return self.ssh + [host, "--", self.remote_python, "-u", "-"]
+
+    def _popen_env(self) -> Optional[dict]:
+        return None  # the ssh CLIENT runs with the driver's env
+
+    def spawn(self, *, host, connect, env, authkey_hex, log_path):
+        source = _bootstrap_source(
+            connect, {**self.env, **env}, authkey_hex, self.pythonpath
+        )
+        logf = open(log_path, "w")
+        try:
+            proc = subprocess.Popen(
+                self._command(host),
+                stdin=subprocess.PIPE,
+                stdout=logf,
+                stderr=subprocess.STDOUT,
+                env=self._popen_env(),
+            )
+        finally:
+            logf.close()
+        proc.stdin.write(source.encode())
+        proc.stdin.close()
+        return proc
+
+
+class LoopbackTransport(SSHTransport):
+    """The SSH bootstrap path without ssh: ``python -u -`` locally, with a
+    scrubbed environment (like a fresh remote login shell — the driver's
+    env does NOT leak in; only the explicit env + bootstrap preamble do).
+
+    Used by tests to drive the cross-host code path — stdin bootstrap,
+    explicit env propagation, routable listener/coordinator addresses —
+    on one machine, and handy as a dev-box smoke of an SSH deployment.
+    """
+
+    #: env vars a login shell would have anyway; everything else is dropped
+    _KEEP = ("PATH", "HOME", "TMPDIR", "LANG", "LC_ALL", "USER", "SHELL")
+
+    def __init__(self, pythonpath: Sequence[str] = (_REPO_ROOT,), **kw):
+        super().__init__(pythonpath=pythonpath, **kw)
+        self.spawned: list = []  # (host, rank) — test introspection
+
+    def _command(self, host):
+        return [sys.executable, "-u", "-"]
+
+    def _popen_env(self):
+        return {k: os.environ[k] for k in self._KEEP if k in os.environ}
+
+    def spawn(self, *, host, connect, env, authkey_hex, log_path):
+        self.spawned.append((host, connect[2]))
+        return super().spawn(
+            host=host, connect=connect, env=env,
+            authkey_hex=authkey_hex, log_path=log_path,
+        )
