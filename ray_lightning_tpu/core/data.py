@@ -12,6 +12,7 @@ rank=global_rank, shuffle per-epoch). TPU-first differences:
 """
 from __future__ import annotations
 
+import os
 from typing import Any, Callable, Iterable, Iterator, Optional
 
 import numpy as np
@@ -34,6 +35,7 @@ class DataLoader:
         num_shards: int = 1,
         shard_index: int = 0,
         prefetch: bool = False,
+        num_workers: Optional[int] = None,
     ):
         self.data = data
         self.batch_size = batch_size
@@ -43,6 +45,7 @@ class DataLoader:
         self.num_shards = num_shards
         self.shard_index = shard_index
         self.prefetch = prefetch
+        self._num_workers = num_workers
         self._batcher = None
         self._epoch = 0
         self._stream = callable(data)
@@ -56,6 +59,16 @@ class DataLoader:
         for leaf in leaves:
             if len(leaf) != self._n:
                 raise ValueError("all arrays must share leading dim")
+
+    @property
+    def num_workers(self) -> int:
+        """Prefetch thread-pool size. Resolved LAZILY so a strategy's env
+        injection (RayXlaPlugin num_cpus_per_worker → RLT_NUM_CPUS_PER_WORKER,
+        reference ray_ddp.py:89-111) applies even when the loader was
+        constructed before Trainer.fit ran strategy.setup()."""
+        if self._num_workers is not None:
+            return max(1, self._num_workers)
+        return max(1, int(os.environ.get("RLT_NUM_CPUS_PER_WORKER", 2)))
 
     def set_epoch(self, epoch: int) -> None:
         """Reference parity: DistributedSampler.set_epoch reshuffles per epoch."""
@@ -115,6 +128,7 @@ class DataLoader:
 
             self._batcher = NativeBatcher(
                 self.data, self.batch_size, drop_last=self.drop_last,
+                n_threads=self.num_workers,
             )
         except (RuntimeError, ValueError):
             self.prefetch = False  # don't retry every epoch

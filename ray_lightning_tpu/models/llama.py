@@ -35,6 +35,7 @@ from ray_lightning_tpu.ops.attention import (
     dot_product_attention,
     flash_attention,
 )
+from ray_lightning_tpu.ops.fused_ce import fused_cross_entropy
 from ray_lightning_tpu.ops.ring_attention import ring_attention
 from ray_lightning_tpu.ops.ulysses import ulysses_attention
 from ray_lightning_tpu.ops.norms import rms_norm
@@ -65,6 +66,12 @@ class LlamaConfig:
     #: any head count) or "ulysses" (head/sequence all_to_all,
     #: ops/ulysses.py — two collectives, needs heads % seq == 0).
     seq_parallel_mode: str = "ring"
+    #: fused chunked cross-entropy (ops/fused_ce.py): training/eval loss
+    #: never materializes the [B, S, V] logits — the dominant activation
+    #: at V=128256. predict/generate still produce real logits.
+    fused_ce: bool = True
+    #: logits tile height for the fused CE scan (C×V live logits memory)
+    ce_chunk_tokens: int = 1024
 
     def __post_init__(self):
         if self.seq_parallel_mode not in ("ring", "ulysses"):
@@ -181,14 +188,16 @@ class Llama(nn.Module):
 
     @nn.compact
     def __call__(self, tokens: jnp.ndarray, cache=None, pos=None,
-                 last_only: bool = False):
+                 last_only: bool = False, return_hidden: bool = False):
         """Training/eval: ``model(tokens) -> logits``. Decoding:
         ``model(tokens, cache=(k, v), pos=p) -> (logits, new_cache)``
         with cache leaves stacked over layers ([L, B, S_max, Hkv, hd];
         see `init_cache`) and ``p`` the write offset (python 0 for a
         fresh prefill, traced thereafter). ``last_only`` projects only
         the final position through the lm_head (prefill wants one row of
-        logits, not [S, vocab])."""
+        logits, not [S, vocab]). ``return_hidden`` skips the lm_head and
+        returns the final-norm'd [B, S, D] states — the fused-CE loss
+        path projects them chunk-wise (ops/fused_ce.py)."""
         cfg = self.cfg
         embed = nn.Embed(
             cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
@@ -243,6 +252,10 @@ class Llama(nn.Module):
         if last_only:
             x = x[:, -1:, :]
         x = rms_norm(x, final_w, cfg.norm_eps)
+        if return_hidden:
+            # lm_head params still exist (init traces the default path);
+            # the loss projects these states tile-by-tile instead.
+            return x
         if cfg.tie_embeddings:
             logits = embed.attend(x.astype(jnp.float32))
         else:
@@ -432,17 +445,30 @@ class LlamaModule(TpuModule):
             return toks[:, :-1], toks[:, 1:], batch.get("mask")
         return batch["inputs"], batch["targets"], batch.get("mask")
 
+    def _loss(self, params, inputs, targets, mask):
+        if self.cfg.fused_ce:
+            hidden = self.apply(params, inputs, return_hidden=True)
+            if self.cfg.tie_embeddings:
+                w = params["tok_embed"]["embedding"].T
+            else:
+                w = params["lm_head"]["kernel"]
+            return fused_cross_entropy(
+                hidden, w, targets, mask,
+                chunk_tokens=self.cfg.ce_chunk_tokens,
+                compute_dtype=self.cfg.dtype,
+            )
+        logits = self.apply(params, inputs)
+        return cross_entropy_loss(logits, targets, mask)
+
     def training_step(self, params, batch, rng):
         inputs, targets, mask = self._split(batch)
-        logits = self.apply(params, inputs)
-        loss = cross_entropy_loss(logits, targets, mask)
+        loss = self._loss(params, inputs, targets, mask)
         self.log("train_loss", loss)
         return loss
 
     def validation_step(self, params, batch):
         inputs, targets, mask = self._split(batch)
-        logits = self.apply(params, inputs)
-        return {"val_loss": cross_entropy_loss(logits, targets, mask)}
+        return {"val_loss": self._loss(params, inputs, targets, mask)}
 
     def predict_step(self, params, batch):
         inputs, _, _ = self._split(batch)

@@ -11,6 +11,7 @@ from ray_lightning_tpu.ops.attention import (
     flash_attention,
     make_causal_mask,
 )
+from ray_lightning_tpu.ops.fused_ce import fused_cross_entropy
 from ray_lightning_tpu.ops.norms import rms_norm
 from ray_lightning_tpu.ops.ring_attention import (
     ring_attention,
@@ -27,6 +28,7 @@ __all__ = [
     "ulysses_attention_local",
     "dot_product_attention",
     "flash_attention",
+    "fused_cross_entropy",
     "make_causal_mask",
     "ring_attention",
     "ring_attention_local",

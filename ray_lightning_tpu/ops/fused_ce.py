@@ -1,0 +1,94 @@
+"""Fused (chunked) cross-entropy: lm_head projection + CE without ever
+materializing the full [B, S, V] logits tensor.
+
+The HBM hazard: at Llama-3-8B scale (V=128256) full-sequence f32 logits
+are ~2 GB per 4k-token microbatch — they dominate activation memory and
+stall the matmul pipeline on writeback. (The reference has no LM path at
+all — its models are MLPs, reference tests/utils.py:96-120 — so this is
+net-new capability, built TPU-first.)
+
+Design (XLA-idiomatic, no hand-scheduling):
+  * flatten tokens, `lax.scan` over chunks of C tokens: each step computes
+    a [C, V] logits tile (bf16 matmul on the MXU, f32 accumulation via
+    ``preferred_element_type``), reduces it to per-token loss, and
+    discards it — live logits memory is O(C·V) instead of O(B·S·V);
+  * `jax.checkpoint` on the chunk body: backward RECOMPUTES the tile
+    instead of saving it, so the residual set stays O(C·V) there too
+    (the classic Liger-style fused-CE memory shape, expressed as remat
+    + scan rather than a hand-written kernel — XLA fuses the matmul,
+    logsumexp and subtraction into the tile);
+  * grad w.r.t. the lm_head weight accumulates across scan steps
+    automatically (scan's backward carries the cotangent sum).
+
+Matches `cross_entropy_loss` (models/llama.py) bit-for-bit in f32 up to
+reduction order.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def fused_cross_entropy(
+    hidden: jnp.ndarray,
+    lm_head: jnp.ndarray,
+    targets: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+    chunk_tokens: int = 1024,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+) -> jnp.ndarray:
+    """Mean token CE of ``normalize(hidden) @ lm_head`` vs ``targets``.
+
+    hidden:  [B, S, D] final-norm'd activations (any float dtype).
+    lm_head: [D, V] projection weight (the `lm_head/kernel` param, or the
+             transposed embedding for tied-embedding models).
+    targets: [B, S] int labels.
+    mask:    optional [B, S] 0/1 validity mask.
+    chunk_tokens: logits tile height C; live logits memory is C×V.
+
+    Returns the scalar mean loss (f32), masked-token weighted.
+    """
+    B, S, D = hidden.shape
+    T = B * S
+    x = hidden.reshape(T, D).astype(compute_dtype)
+    t = targets.reshape(T)
+    m = (jnp.ones((T,), jnp.float32) if mask is None
+         else mask.reshape(T).astype(jnp.float32))
+    w = lm_head.astype(compute_dtype)
+
+    # Static tiling: pad T up to a multiple of the tile height with
+    # zero-masked rows (never fall back to one giant tile — an awkward
+    # prime T must not silently materialize the [T, V] logits this
+    # function exists to avoid).
+    C = min(max(1, chunk_tokens), T)
+    pad = (-T) % C
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad, D), x.dtype)])
+        t = jnp.concatenate([t, jnp.zeros((pad,), t.dtype)])
+        m = jnp.concatenate([m, jnp.zeros((pad,), m.dtype)])
+    n_chunks = (T + pad) // C
+
+    @jax.checkpoint
+    def chunk_loss(x_c, t_c):
+        # [C, V] tile: bf16 MXU matmul, f32 accumulation
+        logits = jnp.dot(x_c, w, preferred_element_type=jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, t_c[:, None], axis=-1)[:, 0]
+        return lse - tgt  # [C] f32
+
+    def body(carry, inp):
+        loss_sum, weight_sum = carry
+        x_c, t_c, m_c = inp
+        losses = chunk_loss(x_c, t_c)
+        return (loss_sum + (losses * m_c).sum(),
+                weight_sum + m_c.sum()), None
+
+    (loss_sum, weight_sum), _ = jax.lax.scan(
+        body,
+        (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        (x.reshape(n_chunks, C, D), t.reshape(n_chunks, C),
+         m.reshape(n_chunks, C)),
+    )
+    return loss_sum / jnp.maximum(weight_sum, 1.0)

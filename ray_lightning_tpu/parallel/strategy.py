@@ -322,14 +322,29 @@ class SingleDevice(Strategy):
         return mesh_lib.MeshSpec()
 
 
-# Reference-familiar aliases: `RayPlugin` → the TPU DP strategy; the north
-# star names it RayXlaPlugin (BASELINE.json). `use_gpu`/`num_cpus_per_worker`
-# are accepted-and-ignored for drop-in ergonomics.
+# Reference-familiar alias: `RayPlugin` → the TPU DP strategy; the north
+# star names it RayXlaPlugin (BASELINE.json).
 class RayXlaPlugin(DataParallel):
-    def __init__(self, num_workers: Optional[int] = None, num_cpus_per_worker: int = 1,
+    """Drop-in ctor shape of the reference's RayPlugin (ray_ddp.py:89-94).
+
+    ``num_cpus_per_worker`` is honored as the per-worker host-CPU budget:
+    it is exported through the strategy's env injection and sizes the data
+    pipeline's prefetch thread pool (core/data.py); pair it with
+    ``TpuResources(cpus=...)`` for sweep-level packing. ``use_gpu`` has no
+    TPU meaning and warns when set (the device set IS the TPU slice).
+    """
+
+    def __init__(self, num_workers: Optional[int] = None,
+                 num_cpus_per_worker: int = 1,
                  use_gpu: bool = False, init_hook=None, **kwargs):
-        del num_cpus_per_worker, use_gpu
-        super().__init__(num_workers=num_workers, init_hook=init_hook, **kwargs)
+        if use_gpu:
+            log.warning("RayXlaPlugin(use_gpu=True) ignored: this is the "
+                        "TPU backend; devices come from the slice topology")
+        env = dict(kwargs.pop("env", None) or {})
+        env.setdefault("RLT_NUM_CPUS_PER_WORKER", str(max(1, num_cpus_per_worker)))
+        self.num_cpus_per_worker = max(1, num_cpus_per_worker)
+        super().__init__(num_workers=num_workers, init_hook=init_hook,
+                         env=env, **kwargs)
 
 
 # ---- spec helpers --------------------------------------------------------

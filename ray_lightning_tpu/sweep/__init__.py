@@ -25,6 +25,7 @@ from ray_lightning_tpu.sweep.schedulers import (
 )
 from ray_lightning_tpu.sweep.session import (
     TrialStopped,
+    get_checkpoint,
     get_trial_dir,
     get_trial_id,
     is_trial_session_enabled,
@@ -56,6 +57,7 @@ __all__ = [
     "report",
     "get_trial_id",
     "get_trial_dir",
+    "get_checkpoint",
     "is_trial_session_enabled",
     "TrialStopped",
     "choice",

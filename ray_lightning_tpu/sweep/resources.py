@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass
+from typing import Optional
 
 
 @dataclass(frozen=True)
@@ -25,29 +26,51 @@ class TpuResources:
     hosts  — host processes the trial will launch (driver-side bookkeeping
              only; on CI these are subprocesses, on a pod they are per-host
              runtimes).
+    cpus   — host CPUs the trial's workers consume (data pipeline /
+             prefetch threads). The reference let trials reserve CPUs
+             independently of accelerators (num_cpus_per_worker +
+             extra_cpu, reference ray_ddp.py:89-111 and
+             examples/ray_ddp_example.py:107-112); 0 = unaccounted.
     """
 
     chips: int = 1
     hosts: int = 1
+    cpus: int = 0
 
     def __post_init__(self):
         if self.chips < 1 or self.hosts < 1:
             raise ValueError(f"resources must be >= 1, got {self}")
+        if self.cpus < 0:
+            raise ValueError(f"cpus must be >= 0, got {self}")
 
 
 class ResourcePool:
-    """Thread-safe integral-block allocator over a fixed chip budget."""
+    """Thread-safe integral-block allocator over fixed chip + CPU budgets.
 
-    def __init__(self, total_chips: int):
+    Chips are the primary (integral-slice) constraint; CPUs are the
+    secondary one — trial packing is bounded by whichever runs out first
+    (the reference's extra_cpu reserve-don't-occupy accounting,
+    examples/ray_ddp_example.py:107-112, without the oversubscription
+    trick)."""
+
+    def __init__(self, total_chips: int, total_cpus: Optional[int] = None):
         if total_chips < 1:
             raise ValueError("total_chips must be >= 1")
+        if total_cpus is not None and total_cpus < 1:
+            raise ValueError("total_cpus must be >= 1 when given")
         self.total_chips = total_chips
+        self.total_cpus = total_cpus
         self._in_use = 0
+        self._cpus_in_use = 0
         self._lock = threading.Lock()
 
     def max_concurrent(self, per_trial: TpuResources) -> int:
-        """floor(topology / per-trial shape) — SURVEY §7.4 #4."""
-        return self.total_chips // per_trial.chips
+        """floor(topology / per-trial shape) — SURVEY §7.4 #4 — jointly
+        over every accounted dimension."""
+        cap = self.total_chips // per_trial.chips
+        if self.total_cpus is not None and per_trial.cpus > 0:
+            cap = min(cap, self.total_cpus // per_trial.cpus)
+        return cap
 
     def try_acquire(self, res: TpuResources) -> bool:
         with self._lock:
@@ -57,16 +80,31 @@ class ResourcePool:
                     f"{self.total_chips} — an integral slice cannot be "
                     "oversubscribed"
                 )
+            if self.total_cpus is not None and res.cpus > self.total_cpus:
+                raise ValueError(
+                    f"trial wants {res.cpus} cpus but the pool only has "
+                    f"{self.total_cpus}"
+                )
             if self._in_use + res.chips > self.total_chips:
                 return False
+            if (self.total_cpus is not None
+                    and self._cpus_in_use + res.cpus > self.total_cpus):
+                return False
             self._in_use += res.chips
+            self._cpus_in_use += res.cpus
             return True
 
     def release(self, res: TpuResources) -> None:
         with self._lock:
             self._in_use = max(0, self._in_use - res.chips)
+            self._cpus_in_use = max(0, self._cpus_in_use - res.cpus)
 
     @property
     def in_use(self) -> int:
         with self._lock:
             return self._in_use
+
+    @property
+    def cpus_in_use(self) -> int:
+        with self._lock:
+            return self._cpus_in_use

@@ -30,6 +30,9 @@ class TrialContext:
 
     trial_id: str
     trial_dir: str
+    #: checkpoint to resume from (set when the sweep restarts an
+    #: interrupted trial; read via get_checkpoint())
+    last_checkpoint: Optional[str] = None
 
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[str] = None) -> None:
@@ -44,9 +47,11 @@ class RemoteTrialContext(TrialContext):
     socket back to the sweep driver (lazy-connected on first report)."""
 
     def __init__(self, trial_id: str, trial_dir: str,
-                 address: tuple, authkey: bytes):
+                 address: tuple, authkey: bytes,
+                 last_checkpoint: Optional[str] = None):
         self.trial_id = trial_id
         self.trial_dir = trial_dir
+        self.last_checkpoint = last_checkpoint
         self._address = address
         self._authkey = authkey
         self._conn = None
@@ -79,9 +84,11 @@ class LocalTrialContext(TrialContext):
     process); a stop verdict raises immediately."""
 
     def __init__(self, trial_id: str, trial_dir: str,
-                 report_fn: Callable[[str, Dict[str, Any], Optional[str]], str]):
+                 report_fn: Callable[[str, Dict[str, Any], Optional[str]], str],
+                 last_checkpoint: Optional[str] = None):
         self.trial_id = trial_id
         self.trial_dir = trial_dir
+        self.last_checkpoint = last_checkpoint
         self._report_fn = report_fn
 
     def report(self, metrics, checkpoint=None) -> None:
@@ -134,3 +141,23 @@ def report(metrics: Optional[Dict[str, Any]] = None,
     merged = dict(metrics or {})
     merged.update(kw)
     _ctx.report(merged, checkpoint=checkpoint)
+
+
+def get_checkpoint() -> Optional[str]:
+    """Checkpoint path to resume this trial from, or None on a fresh start.
+
+    Set by the sweep runner when re-running an interrupted/errored trial
+    (extends the reference's checkpoint registration, tune.py:128-142, with
+    the restore direction Ray Tune gained later). Trainables opt in::
+
+        def trainable(config):
+            trainer.fit(module, data, ckpt_path=sweep.get_checkpoint())
+
+    Works in the trial process (session-bound) and in nested SPMD workers
+    (via the trial environment).
+    """
+    import os
+
+    if _ctx is not None:
+        return _ctx.last_checkpoint
+    return os.environ.get("RLT_TRIAL_RESUME") or None

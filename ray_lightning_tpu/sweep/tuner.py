@@ -78,12 +78,14 @@ def _probe_device_count(executor: str) -> int:
         return 1
 
 
-def _trial_main(trainable, config, trial_id, trial_dir, address, authkey_hex):
+def _trial_main(trainable, config, trial_id, trial_dir, address, authkey_hex,
+                resume_from=None):
     """Body of one trial — runs inside the trial's own worker process
     (the analog of the reference's trial-actor trainable,
     reference examples/ray_ddp_example.py:61-76)."""
     ctx = trial_session.RemoteTrialContext(
-        trial_id, trial_dir, address, bytes.fromhex(authkey_hex)
+        trial_id, trial_dir, address, bytes.fromhex(authkey_hex),
+        last_checkpoint=resume_from,
     )
     trial_session.init_trial_session(ctx)
     # Nested SPMD workers launched by this trial inherit the trial identity
@@ -91,6 +93,8 @@ def _trial_main(trainable, config, trial_id, trial_dir, address, authkey_hex):
     # when the trial session object itself isn't bound in the worker).
     os.environ["RLT_TRIAL_ID"] = trial_id
     os.environ["RLT_TRIAL_DIR"] = trial_dir
+    if resume_from:
+        os.environ["RLT_TRIAL_RESUME"] = resume_from
     try:
         result = trainable(config)
         return (Trial.DONE, result)
@@ -215,8 +219,65 @@ class TrialRunner:
             tid = f"trial_{i:05d}"
             tdir = os.path.join(storage_dir, tid)
             os.makedirs(tdir, exist_ok=True)
-            self.trials.append(Trial(tid, cfg, tdir, resources_per_trial))
+            trial = Trial(tid, cfg, tdir, resources_per_trial)
+            # Resume: a rerun over an existing storage_dir restores each
+            # trial's recorded progress; interrupted/errored trials restart
+            # from their last registered checkpoint (extends reference
+            # tune.py:128-142 with the restore direction).
+            self._load_trial_state(trial)
+            self.trials.append(trial)
         self._by_id = {t.trial_id: t for t in self.trials}
+
+    # --------------------------------------------------------- persistence
+    def _state_path(self, trial: Trial) -> str:
+        return os.path.join(trial.trial_dir, "trial_state.json")
+
+    def _save_trial_state(self, trial: Trial) -> None:
+        """Durable per-trial record (atomic rename) so a later sweep.run
+        over the same storage_dir can skip DONE trials and resume the rest."""
+        import json
+
+        state = {
+            "status": trial.status,
+            "history": trial.history,
+            "checkpoints": trial.checkpoints,
+            "error": trial.error,
+        }
+        path = self._state_path(trial)
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(state, f)
+            os.replace(tmp, path)
+        except (OSError, TypeError, ValueError) as exc:
+            log.warning("could not persist %s state: %s", trial.trial_id, exc)
+
+    def _load_trial_state(self, trial: Trial) -> None:
+        import json
+
+        path = self._state_path(trial)
+        if not os.path.exists(path):
+            return
+        try:
+            with open(path) as f:
+                state = json.load(f)
+        except (OSError, ValueError) as exc:
+            log.warning("ignoring unreadable %s state: %s",
+                        trial.trial_id, exc)
+            return
+        trial.history = list(state.get("history", []))
+        if trial.history:
+            trial.last_result = trial.history[-1]
+        trial.checkpoints = list(state.get("checkpoints", []))
+        status = state.get("status")
+        if status in (Trial.DONE, Trial.STOPPED):
+            # terminal: DONE finished; STOPPED was the scheduler's
+            # deliberate early-kill — resurrecting it would let an
+            # intentionally-culled config back into the race
+            trial.status = status
+        # Anything else (error / a stale "running" from a crashed driver)
+        # stays PENDING and will be re-scheduled, resuming from
+        # trial.last_checkpoint if one was registered.
 
     # ------------------------------------------------------------- reports
     def _handle_report(self, trial_id: str, metrics: Dict[str, Any],
@@ -245,20 +306,30 @@ class TrialRunner:
             if verdict != CONTINUE:
                 log.info("scheduler stopping %s at iteration %d", trial_id,
                          iteration)
+            self._save_trial_state(trial)
             return verdict
 
     # -------------------------------------------------------------- inline
     def _run_inline(self) -> None:
         for trial in self.trials:
+            if trial.status in (Trial.DONE, Trial.STOPPED):
+                log.info("skipping %s: already %s", trial.trial_id,
+                         trial.status)
+                self.scheduler.on_trial_complete(trial.trial_id)
+                continue
             trial.status = Trial.RUNNING
             ctx = trial_session.LocalTrialContext(
-                trial.trial_id, trial.trial_dir, self._handle_report
+                trial.trial_id, trial.trial_dir, self._handle_report,
+                last_checkpoint=trial.last_checkpoint,
             )
             trial_session.init_trial_session(ctx)
             saved_env = {k: os.environ.get(k)
-                         for k in ("RLT_TRIAL_ID", "RLT_TRIAL_DIR")}
+                         for k in ("RLT_TRIAL_ID", "RLT_TRIAL_DIR",
+                                   "RLT_TRIAL_RESUME")}
             os.environ["RLT_TRIAL_ID"] = trial.trial_id
             os.environ["RLT_TRIAL_DIR"] = trial.trial_dir
+            if trial.last_checkpoint:
+                os.environ["RLT_TRIAL_RESUME"] = trial.last_checkpoint
             try:
                 trial.result = self.trainable(trial.config)
                 trial.status = Trial.DONE
@@ -276,11 +347,17 @@ class TrialRunner:
                     else:
                         os.environ[k] = v
                 self.scheduler.on_trial_complete(trial.trial_id)
+                self._save_trial_state(trial)
 
     # ------------------------------------------------------------- process
     def _run_process(self) -> None:
         server = _ReportServer(self._handle_report)
-        pending = deque(self.trials)
+        terminal = (Trial.DONE, Trial.STOPPED)
+        for t in self.trials:
+            if t.status in terminal:
+                log.info("skipping %s: already %s", t.trial_id, t.status)
+                self.scheduler.on_trial_complete(t.trial_id)
+        pending = deque(t for t in self.trials if t.status not in terminal)
         running: set = set()
         try:
             with self._cond:
@@ -314,7 +391,7 @@ class TrialRunner:
                 _trial_main,
                 per_rank_args=[(self.trainable, trial.config, trial.trial_id,
                                 trial.trial_dir, server.address,
-                                server.authkey_hex)],
+                                server.authkey_hex, trial.last_checkpoint)],
                 timeout=self.trial_timeout,
             )
             trial.status, trial.result = out
@@ -332,6 +409,7 @@ class TrialRunner:
             group.shutdown()
             self.pool.release(self.resources)
             self.scheduler.on_trial_complete(trial.trial_id)
+            self._save_trial_state(trial)
             with self._cond:
                 running.discard(trial.trial_id)
                 self._cond.notify_all()
@@ -357,6 +435,7 @@ def run(
     scheduler: Optional[TrialScheduler] = None,
     resources_per_trial: Optional[TpuResources] = None,
     total_chips: Optional[int] = None,
+    total_cpus: Optional[int] = None,
     max_concurrent: Optional[int] = None,
     storage_dir: Optional[str] = None,
     name: str = "sweep",
@@ -391,7 +470,11 @@ def run(
     if total_chips is None:
         total_chips = max(_probe_device_count(executor),
                           resources_per_trial.chips)
-    pool = ResourcePool(total_chips)
+    if total_cpus is None and resources_per_trial.cpus > 0:
+        # trials reserve CPUs -> account against this machine's cores
+        # (reference analog: Tune's cluster CPU pool)
+        total_cpus = max(os.cpu_count() or 1, resources_per_trial.cpus)
+    pool = ResourcePool(total_chips, total_cpus)
     storage_dir = storage_dir or os.path.join(os.getcwd(), "rlt_sweeps", name)
     os.makedirs(storage_dir, exist_ok=True)
 

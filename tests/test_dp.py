@@ -100,3 +100,28 @@ def test_ray_xla_plugin_alias(tmp_path):
     trainer.fit(BoringModel(), DataLoader(random_dataset(), batch_size=32))
     assert called.get("hook"), "init_hook did not run (ray_ddp.py:118-119)"
     assert strategy.mesh.shape["data"] == 2
+
+
+def test_ray_xla_plugin_cpu_budget(tmp_path, monkeypatch):
+    """num_cpus_per_worker is honored: exported as the per-worker CPU
+    budget and consumed as the data pipeline's thread-pool size
+    (reference per-worker CPU reservation, ray_ddp.py:89-111)."""
+    import os
+
+    monkeypatch.delenv("RLT_NUM_CPUS_PER_WORKER", raising=False)
+    # loader built BEFORE fit/setup — the budget must still apply (the
+    # pool size is resolved lazily, not at construction)
+    early_loader = DataLoader(random_dataset(), batch_size=32)
+    strategy = RayXlaPlugin(num_workers=2, num_cpus_per_worker=3)
+    assert strategy.num_cpus_per_worker == 3
+    try:
+        strategy.setup()
+        assert os.environ["RLT_NUM_CPUS_PER_WORKER"] == "3"
+        assert early_loader.num_workers == 3
+        assert DataLoader(random_dataset(), batch_size=32,
+                          num_workers=5).num_workers == 5
+    finally:
+        # strategy.setup writes os.environ directly; monkeypatch has no
+        # undo registered for a key that was absent
+        os.environ.pop("RLT_NUM_CPUS_PER_WORKER", None)
+    assert DataLoader(random_dataset(), batch_size=32).num_workers == 2
