@@ -1,0 +1,109 @@
+"""Cross-host (pod) launch: one driver script places one worker per host
+VM over a pluggable transport and runs ONE SPMD fit across all of them.
+
+Parity target: the reference's signature capability — workers placed on
+arbitrary cluster nodes by the Ray scheduler with env bootstrap + rank
+resolution (reference ray_ddp.py:106-164). Here placement is a transport
+(runtime/transport.py): `SSHTransport` on a real pod, `LoopbackTransport`
+to exercise the identical bootstrap/rendezvous path on one machine.
+
+Run on a real v5p pod (driver on any VM with ssh to the hosts):
+    python examples/pod_launch_example.py \
+        --hosts 10.164.0.2 10.164.0.3 ... --remote-python python3
+
+Locally / CI (full remote code path, fake hosts, CPU devices):
+    python examples/pod_launch_example.py --smoke-test
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_module():
+    from ray_lightning_tpu.models.mlp import MLPClassifier
+
+    return MLPClassifier(features=(64,), num_classes=4, lr=5e-2)
+
+
+def make_trainer():
+    from ray_lightning_tpu import DataParallel, Trainer
+
+    return Trainer(
+        strategy=DataParallel(),
+        max_epochs=2,
+        enable_progress_bar=False,
+        enable_checkpointing=False,
+        seed=0,
+    )
+
+
+def make_data():
+    import jax
+
+    from ray_lightning_tpu import DataLoader
+
+    rng = np.random.default_rng(0)
+    centers = rng.normal(size=(4, 16)) * 3
+    y = rng.integers(0, 4, size=512)
+    x = (centers[y] + rng.normal(size=(512, 16)) * 0.1).astype(np.float32)
+    # each host loads ITS shard of the global batch (the
+    # DistributedSampler analog, reference ray_ddp.py:293-303)
+    train = DataLoader({"x": x, "y": y}, batch_size=32, shuffle=True,
+                       num_shards=jax.process_count(),
+                       shard_index=jax.process_index())
+    val = DataLoader({"x": x, "y": y}, batch_size=32,
+                     num_shards=jax.process_count(),
+                     shard_index=jax.process_index())
+    return train, val
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--hosts", nargs="+", default=None,
+                        help="host VM addresses, one worker per host")
+    parser.add_argument("--remote-python", default="python3")
+    parser.add_argument("--smoke-test", action="store_true",
+                        help="fake 2-host run on local CPU devices")
+    args = parser.parse_args()
+
+    from ray_lightning_tpu.runtime import (
+        LoopbackTransport,
+        SSHTransport,
+        fit_distributed,
+    )
+
+    if args.smoke_test:
+        hosts = ["fake-host-a", "fake-host-b"]
+        transport = LoopbackTransport()
+        extra = dict(platform="cpu", num_cpu_devices_per_process=2,
+                     env={"JAX_PLATFORMS": "cpu"})
+    else:
+        if not args.hosts:
+            parser.error("--hosts is required without --smoke-test")
+        hosts = args.hosts
+        transport = SSHTransport(remote_python=args.remote_python)
+        extra = {}
+
+    result = fit_distributed(
+        make_module, make_trainer, make_data,
+        num_processes=len(hosts),
+        hosts=hosts,
+        transport=transport,
+        timeout=600,
+        **extra,
+    )
+    acc = result.metrics.get("ptl/val_accuracy")
+    print(f"workers={len(hosts)} hosts={hosts}")
+    print(f"final metrics: {result.metrics}")
+    assert acc is not None and acc > 0.9, acc
+    print("pod launch round-trip OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -14,6 +14,7 @@ from ray_lightning_tpu.core import (
     EarlyStopping,
     ModelCheckpoint,
     ProgressLogger,
+    MemoryMonitor,
     ThroughputMonitor,
     TpuModule,
     TrainState,
@@ -50,6 +51,7 @@ __all__ = [
     "EarlyStopping",
     "ModelCheckpoint",
     "ProgressLogger",
+    "MemoryMonitor",
     "ThroughputMonitor",
     "Strategy",
     "DataParallel",

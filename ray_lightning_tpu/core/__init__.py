@@ -12,6 +12,7 @@ from ray_lightning_tpu.core.callbacks import (
     EarlyStopping,
     ModelCheckpoint,
     ProgressLogger,
+    MemoryMonitor,
     ThroughputMonitor,
 )
 
@@ -28,5 +29,6 @@ __all__ = [
     "EarlyStopping",
     "ModelCheckpoint",
     "ProgressLogger",
+    "MemoryMonitor",
     "ThroughputMonitor",
 ]

@@ -224,6 +224,41 @@ class ThroughputMonitor(Callback):
                 trainer.callback_metrics["examples_per_sec"] = bs / step_time
 
 
+class MemoryMonitor(Callback):
+    """Per-epoch device HBM stats (bytes in use / peak) from PJRT's
+    ``memory_stats`` — §5.5 observability the reference lacked entirely.
+    Feeds ``hbm_bytes_in_use`` / ``hbm_peak_bytes`` into callback_metrics
+    and logs them; silently inert on backends without memory_stats (CPU)."""
+
+    def __init__(self, log_stats: bool = True):
+        self.log_stats = log_stats
+
+    @staticmethod
+    def _stats() -> Optional[dict]:
+        import jax
+
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+        except Exception:  # noqa: BLE001 — interface is backend-optional
+            return None
+        return stats or None
+
+    def on_train_epoch_end(self, trainer, module) -> None:
+        stats = self._stats()
+        if stats is None:
+            return
+        in_use = stats.get("bytes_in_use")
+        peak = stats.get("peak_bytes_in_use")
+        if in_use is not None:
+            trainer.callback_metrics["hbm_bytes_in_use"] = float(in_use)
+        if peak is not None:
+            trainer.callback_metrics["hbm_peak_bytes"] = float(peak)
+        if self.log_stats and peak is not None:
+            log.info("epoch %d HBM peak %.2f GiB (in use %.2f GiB)",
+                     trainer.current_epoch, peak / 2**30,
+                     (in_use or 0) / 2**30)
+
+
 class ProgressLogger(Callback):
     """Console progress (the reference inherited PTL's bar; headless here)."""
 

@@ -56,6 +56,11 @@ class LlamaConfig:
     tie_embeddings: bool = False
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    #: what the per-layer checkpoint saves: "nothing" (max memory savings,
+    #: full recompute in backward), "dots" (save matmul outputs, recompute
+    #: only elementwise — the usual best speed/memory point when HBM
+    #: allows). Ignored when remat=False.
+    remat_policy: str = "nothing"
     scan_layers: bool = True
     use_flash: bool = True
     #: shard attention over the mesh's `seq` axis — long-context training
@@ -69,7 +74,11 @@ class LlamaConfig:
     #: fused chunked cross-entropy (ops/fused_ce.py): training/eval loss
     #: never materializes the [B, S, V] logits — the dominant activation
     #: at V=128256. predict/generate still produce real logits.
-    fused_ce: bool = True
+    #: None = auto: fused for large vocabularies (>= 64k, where the
+    #: materialized logits dominate HBM and may not compile at all),
+    #: materialized otherwise (marginally faster, bit-identical to the
+    #: historical loss path). Set True/False to force.
+    fused_ce: Optional[bool] = None
     #: logits tile height for the fused CE scan (C×V live logits memory)
     ce_chunk_tokens: int = 1024
 
@@ -78,6 +87,11 @@ class LlamaConfig:
             raise ValueError(
                 f"seq_parallel_mode must be 'ring' or 'ulysses', got "
                 f"{self.seq_parallel_mode!r}"
+            )
+        if self.remat_policy not in ("nothing", "dots"):
+            raise ValueError(
+                f"remat_policy must be 'nothing' or 'dots', got "
+                f"{self.remat_policy!r}"
             )
 
     @property
@@ -212,9 +226,11 @@ class Llama(nn.Module):
 
         block = LlamaBlock
         if cfg.remat and cache is None:
-            block = nn.remat(
-                block, policy=jax.checkpoint_policies.nothing_saveable
-            )
+            policy = {
+                "nothing": jax.checkpoint_policies.nothing_saveable,
+                "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            }[cfg.remat_policy]
+            block = nn.remat(block, policy=policy)
         new_cache = None
         if cfg.scan_layers:
             # one compiled block, scanned over a stacked-params layer axis
@@ -445,8 +461,13 @@ class LlamaModule(TpuModule):
             return toks[:, :-1], toks[:, 1:], batch.get("mask")
         return batch["inputs"], batch["targets"], batch.get("mask")
 
+    def _use_fused_ce(self) -> bool:
+        if self.cfg.fused_ce is not None:
+            return self.cfg.fused_ce
+        return self.cfg.vocab_size >= 2**16
+
     def _loss(self, params, inputs, targets, mask):
-        if self.cfg.fused_ce:
+        if self._use_fused_ce():
             hidden = self.apply(params, inputs, return_hidden=True)
             if self.cfg.tie_embeddings:
                 w = params["tok_embed"]["embedding"].T

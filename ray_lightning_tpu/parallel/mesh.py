@@ -67,8 +67,53 @@ class MeshSpec:
         devices = list(devices if devices is not None else jax.devices())
         spec = self.resolve(len(devices))
         shape = tuple(spec.sizes()[ax] for ax in AXIS_ORDER)
+        devices = order_devices_for_slices(devices, spec)
         arr = np.asarray(devices).reshape(shape)
         return Mesh(arr, AXIS_ORDER)
+
+
+def order_devices_for_slices(devices: Sequence, spec: "MeshSpec") -> list:
+    """Multi-slice (DCN) aware device ordering.
+
+    On a multi-slice TPU deployment each device carries a ``slice_index``;
+    ICI only spans a slice, slices talk over DCN. The mesh's OUTERMOST
+    axis (`data`, AXIS_ORDER[0]) must therefore vary across slices so the
+    only cross-slice collective is the gradient all-reduce, while
+    fsdp/tensor/seq/expert groups stay inside a slice on ICI (the layout
+    contract stated at the top of this module; the reference has no
+    analog — NCCL ring costs were Ray's problem, SURVEY §2.2).
+
+    Returns devices slice-major (slice 0's devices first, stable order
+    within a slice) so ``reshape(data, ...)`` puts whole slices under
+    distinct `data` coordinates. Single-slice (or CPU) inputs come back
+    unchanged. Raises when `data` cannot absorb the slice count or slices
+    are uneven — a mesh silently splitting tensor groups across DCN would
+    be a performance cliff, not a config choice.
+    """
+    slice_ids = sorted(
+        {getattr(d, "slice_index", None) or 0 for d in devices}
+    )
+    if len(slice_ids) <= 1:
+        return list(devices)
+    n_slices = len(slice_ids)
+    by_slice = {s: [] for s in slice_ids}
+    for d in devices:
+        by_slice[getattr(d, "slice_index", None) or 0].append(d)
+    per = len(devices) // n_slices
+    if any(len(v) != per for v in by_slice.values()):
+        raise ValueError(
+            f"uneven slices: { {s: len(v) for s, v in by_slice.items()} }"
+        )
+    if spec.data % n_slices != 0:
+        raise ValueError(
+            f"data axis ({spec.data}) must be a multiple of the slice "
+            f"count ({n_slices}) so only data-parallel gradient reduction "
+            "crosses DCN; tensor/seq/fsdp groups cannot span slices"
+        )
+    out: list = []
+    for s in slice_ids:
+        out.extend(by_slice[s])
+    return out
 
 
 def make_mesh(

@@ -335,14 +335,19 @@ class RayXlaPlugin(DataParallel):
     """
 
     def __init__(self, num_workers: Optional[int] = None,
-                 num_cpus_per_worker: int = 1,
+                 num_cpus_per_worker: Optional[int] = None,
                  use_gpu: bool = False, init_hook=None, **kwargs):
         if use_gpu:
             log.warning("RayXlaPlugin(use_gpu=True) ignored: this is the "
                         "TPU backend; devices come from the slice topology")
         env = dict(kwargs.pop("env", None) or {})
-        env.setdefault("RLT_NUM_CPUS_PER_WORKER", str(max(1, num_cpus_per_worker)))
-        self.num_cpus_per_worker = max(1, num_cpus_per_worker)
+        if num_cpus_per_worker is not None:
+            # only an EXPLICIT budget is exported — a default injection
+            # would leak into os.environ and retune every DataLoader in
+            # the process, not just this strategy's
+            env.setdefault("RLT_NUM_CPUS_PER_WORKER",
+                           str(max(1, num_cpus_per_worker)))
+        self.num_cpus_per_worker = max(1, num_cpus_per_worker or 1)
         super().__init__(num_workers=num_workers, init_hook=init_hook,
                          env=env, **kwargs)
 

@@ -243,6 +243,11 @@ class TrialRunner:
             "checkpoints": trial.checkpoints,
             "error": trial.error,
         }
+        try:
+            json.dumps(trial.result)
+            state["result"] = trial.result
+        except (TypeError, ValueError):
+            pass  # non-JSON trainable return: status/history still persist
         path = self._state_path(trial)
         tmp = path + ".tmp"
         try:
@@ -269,6 +274,7 @@ class TrialRunner:
         if trial.history:
             trial.last_result = trial.history[-1]
         trial.checkpoints = list(state.get("checkpoints", []))
+        trial.result = state.get("result")
         status = state.get("status")
         if status in (Trial.DONE, Trial.STOPPED):
             # terminal: DONE finished; STOPPED was the scheduler's

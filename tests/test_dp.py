@@ -109,6 +109,9 @@ def test_ray_xla_plugin_cpu_budget(tmp_path, monkeypatch):
     import os
 
     monkeypatch.delenv("RLT_NUM_CPUS_PER_WORKER", raising=False)
+    # the DEFAULT ctor must not inject a budget (it would retune every
+    # DataLoader in the process, not just this strategy's)
+    assert "RLT_NUM_CPUS_PER_WORKER" not in RayXlaPlugin(num_workers=2).env
     # loader built BEFORE fit/setup — the budget must still apply (the
     # pool size is resolved lazily, not at construction)
     early_loader = DataLoader(random_dataset(), batch_size=32)

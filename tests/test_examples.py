@@ -68,3 +68,9 @@ def test_cifar_resnet_example(tmp_path):
 def test_bert_finetune_example(tmp_path):
     out = _run("bert_finetune_example.py", cwd=str(tmp_path))
     assert "val_acc=" in out
+
+
+@pytest.mark.slow
+def test_pod_launch_example(tmp_path):
+    out = _run("pod_launch_example.py", cwd=str(tmp_path))
+    assert "pod launch round-trip OK" in out

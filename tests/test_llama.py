@@ -76,6 +76,36 @@ class TestLlamaForward:
         np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_u),
                                    atol=2e-5)
 
+    def test_remat_policies_equivalent(self):
+        """remat off / full / dots-saveable are schedule choices, not math:
+        losses and grads must agree."""
+        import jax.numpy as jnp
+
+        from ray_lightning_tpu.models.llama import LlamaModule
+
+        tokens = {"tokens": (np.arange(34, dtype=np.int32).reshape(2, 17)
+                             % 64)}
+        outs = []
+        for remat, policy in ((False, "nothing"), (True, "nothing"),
+                              (True, "dots")):
+            cfg = LlamaConfig(
+                vocab_size=64, dim=32, n_layers=2, n_heads=2, n_kv_heads=1,
+                hidden_dim=64, max_seq_len=64, use_flash=False,
+                dtype=jnp.float32, remat=remat, remat_policy=policy)
+            m = LlamaModule(cfg)
+            m.setup()
+            params = m.init_params(jax.random.key(0), tokens)
+            i, t, msk = m._split(tokens)
+            loss, grads = jax.value_and_grad(
+                lambda p: m._loss(p, i, t, msk))(params)
+            outs.append((np.asarray(loss), grads))
+        for loss, grads in outs[1:]:
+            np.testing.assert_allclose(loss, outs[0][0], rtol=1e-5)
+            for a, b in zip(jax.tree.leaves(grads),
+                            jax.tree.leaves(outs[0][1])):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=1e-4, atol=1e-6)
+
     def test_causality(self):
         """Changing a future token must not affect earlier logits."""
         cfg = LlamaConfig.tiny(use_flash=False)

@@ -1,0 +1,97 @@
+"""Mesh construction and multi-slice (DCN) layout: the `data` axis must be
+the only thing that spans slices (the layout contract of parallel/mesh.py;
+gradient all-reduce rides DCN, tensor/seq/fsdp collectives stay on ICI)."""
+import dataclasses
+
+import jax
+import pytest
+
+from ray_lightning_tpu.parallel.mesh import (
+    MeshSpec,
+    batch_size_divisor,
+    dp_axis_names,
+    order_devices_for_slices,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class FakeDev:
+    """Stand-in for a multi-slice TPU device (CPU devices carry no
+    slice_index, so multi-slice layout is tested with fakes)."""
+
+    id: int
+    slice_index: int
+
+
+def test_meshspec_resolve_wildcard():
+    spec = MeshSpec(data=-1, tensor=2).resolve(8)
+    assert spec.data == 4 and spec.tensor == 2
+    with pytest.raises(ValueError):
+        MeshSpec(data=-1, fsdp=-1).resolve(8)
+    with pytest.raises(ValueError):
+        MeshSpec(data=3).resolve(8)
+
+
+def test_meshspec_build_and_dp_axes(devices8):
+    mesh = MeshSpec(data=2, fsdp=2, tensor=2).build(devices8)
+    assert dict(mesh.shape) == {"data": 2, "fsdp": 2, "expert": 1,
+                                "seq": 1, "tensor": 2}
+    assert dp_axis_names(mesh) == ("data", "fsdp")
+    assert batch_size_divisor(mesh) == 4
+
+
+def test_single_slice_order_unchanged(devices8):
+    spec = MeshSpec(data=8)
+    assert order_devices_for_slices(devices8, spec) == list(devices8)
+
+
+def test_multislice_orders_slice_major():
+    # interleaved arrival order (as jax.devices() can present them)
+    devs = [FakeDev(i, slice_index=i % 2) for i in range(8)]
+    spec = MeshSpec(data=2, fsdp=2, tensor=2)
+    out = order_devices_for_slices(devs, spec)
+    # slice 0's four devices first, then slice 1's — so reshape(data=2, ...)
+    # puts each whole slice under one `data` coordinate
+    assert [d.slice_index for d in out] == [0, 0, 0, 0, 1, 1, 1, 1]
+    # stable within a slice
+    assert [d.id for d in out] == [0, 2, 4, 6, 1, 3, 5, 7]
+
+
+def test_multislice_data_must_cover_slices():
+    devs = [FakeDev(i, slice_index=i % 2) for i in range(8)]
+    with pytest.raises(ValueError, match="multiple of the slice count"):
+        order_devices_for_slices(devs, MeshSpec(data=1, tensor=8))
+    # data=4 over 2 slices: fine (2 data groups per slice)
+    out = order_devices_for_slices(devs, MeshSpec(data=4, tensor=2))
+    assert len(out) == 8
+
+
+def test_multislice_uneven_slices_rejected():
+    devs = [FakeDev(i, slice_index=0) for i in range(5)]
+    devs += [FakeDev(5 + i, slice_index=1) for i in range(3)]
+    with pytest.raises(ValueError, match="uneven"):
+        order_devices_for_slices(devs, MeshSpec(data=2, tensor=4))
+
+
+def test_build_with_multislice_fakes():
+    """End-to-end: a mesh built from interleaved multi-slice devices has
+    whole slices under each data coordinate."""
+    devs = [FakeDev(i, slice_index=i % 2) for i in range(8)]
+    spec = MeshSpec(data=2, tensor=4).resolve(8)
+    ordered = order_devices_for_slices(devs, spec)
+    import numpy as np
+
+    arr = np.asarray(ordered, dtype=object).reshape(2, 1, 1, 1, 4)
+    for data_coord in range(2):
+        slices = {d.slice_index for d in arr[data_coord].flat}
+        assert len(slices) == 1, "a data row must live in ONE slice"
+
+
+def test_jax_devices_have_no_fake_attrs(devices8):
+    # guard: the getattr default path (CPU devices) stays on the
+    # single-slice fast path
+    assert all(getattr(d, "slice_index", None) in (None, 0)
+               for d in devices8)
+    mesh = MeshSpec(data=4, tensor=2).build(devices8)
+    assert jax.device_count() >= 8
+    assert mesh.devices.shape == (4, 1, 1, 1, 2)

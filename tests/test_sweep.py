@@ -332,11 +332,13 @@ def test_sweep_trial_resume_after_kill(tmp_path):
     assert t2.result["final_step"] == 8
     assert t2.iterations == 4
 
-    # third run: everything DONE, nothing re-executed
+    # third run: everything DONE, nothing re-executed — and the recorded
+    # trainable return value survives the rerun
     analysis3 = sweep.run(_resumable_trainable, **kw)
     [t3] = analysis3.trials
     assert t3.status == Trial.DONE
     assert t3.iterations == 4
+    assert t3.result == {"final_step": 8, "resumed": True}
 
 
 # ------------------------------ nested: sweep over distributed SPMD fit

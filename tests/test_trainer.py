@@ -107,6 +107,22 @@ def test_validate_and_test_apis(tmp_path):
     assert "val_loss" in tmetrics  # test_step defaults to validation_step
 
 
+def test_memory_monitor(tmp_path):
+    """MemoryMonitor reports HBM stats when the backend exposes them and is
+    silently inert otherwise (CPU may or may not implement memory_stats)."""
+    from ray_lightning_tpu import MemoryMonitor
+
+    module = BoringModel()
+    trainer = get_trainer(tmp_path, SingleDevice(), max_epochs=1,
+                          callbacks=[MemoryMonitor(log_stats=False)])
+    trainer.fit(module, DataLoader(random_dataset(), batch_size=32))
+    stats = MemoryMonitor._stats()
+    if stats and "bytes_in_use" in stats:
+        assert trainer.callback_metrics["hbm_bytes_in_use"] > 0
+    else:
+        assert "hbm_bytes_in_use" not in trainer.callback_metrics
+
+
 def test_eval_epoch_single_host_sync(tmp_path, monkeypatch):
     """Eval totals accumulate on device: exactly ONE host fetch per eval
     epoch regardless of batch count (VERDICT r2 weak #6 — a per-batch
