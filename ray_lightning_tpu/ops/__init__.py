@@ -13,6 +13,7 @@ from ray_lightning_tpu.ops.attention import (
 )
 from ray_lightning_tpu.ops.fused_ce import fused_cross_entropy
 from ray_lightning_tpu.ops.norms import rms_norm
+from ray_lightning_tpu.ops.pipeline import gpipe_apply, pipeline_param_spec
 from ray_lightning_tpu.ops.ring_attention import (
     ring_attention,
     ring_attention_local,
@@ -29,6 +30,8 @@ __all__ = [
     "dot_product_attention",
     "flash_attention",
     "fused_cross_entropy",
+    "gpipe_apply",
+    "pipeline_param_spec",
     "make_causal_mask",
     "ring_attention",
     "ring_attention_local",

@@ -9,6 +9,7 @@ from sharding annotations. Rank helpers become topology queries.
 
 Canonical axis names (outer→inner, DCN-slowest to ICI-fastest):
     data    — pure data parallelism (batch axis)
+    pipe    — pipeline-parallel stages (microbatches flow stage→stage)
     fsdp    — parameter/optimizer-state sharding (ZeRO-style), also carries batch
     tensor  — tensor (Megatron-style) parallelism inside a layer
     seq     — sequence/context parallelism (ring attention)
@@ -24,7 +25,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXIS_ORDER = ("data", "fsdp", "expert", "seq", "tensor")
+AXIS_ORDER = ("data", "pipe", "fsdp", "expert", "seq", "tensor")
 
 # Axes whose groups should ride ICI (fast, intra-slice): tensor/seq innermost.
 # `data` is the outermost axis so multi-slice DCN traffic only carries
@@ -40,6 +41,10 @@ class MeshSpec:
     expert: int = 1
     seq: int = 1
     tensor: int = 1
+    #: pipeline-parallel stages (GPipe building block, ops/pipeline.py);
+    #: appended last so positional (data, fsdp, expert, seq, tensor)
+    #: construction stays valid
+    pipe: int = 1
 
     def sizes(self) -> dict[str, int]:
         return {ax: getattr(self, ax) for ax in AXIS_ORDER}
@@ -122,9 +127,10 @@ def make_mesh(
     expert: int = 1,
     seq: int = 1,
     tensor: int = 1,
+    pipe: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    return MeshSpec(data, fsdp, expert, seq, tensor).build(devices)
+    return MeshSpec(data, fsdp, expert, seq, tensor, pipe).build(devices)
 
 
 # --- Topology queries (replace reference's get_local_ranks / root_device) ---

@@ -290,11 +290,12 @@ class ShardedMesh(Strategy):
         expert: int = 1,
         seq: int = 1,
         tensor: int = 1,
+        pipe: int = 1,
         min_shard_size: int = 2**10,
         **kwargs,
     ):
         super().__init__(**kwargs)
-        self._spec = mesh_lib.MeshSpec(data, fsdp, expert, seq, tensor)
+        self._spec = mesh_lib.MeshSpec(data, fsdp, expert, seq, tensor, pipe)
         self.min_shard_size = min_shard_size
 
     def build_spec(self, n_devices: int) -> mesh_lib.MeshSpec:

@@ -34,8 +34,8 @@ def test_meshspec_resolve_wildcard():
 
 def test_meshspec_build_and_dp_axes(devices8):
     mesh = MeshSpec(data=2, fsdp=2, tensor=2).build(devices8)
-    assert dict(mesh.shape) == {"data": 2, "fsdp": 2, "expert": 1,
-                                "seq": 1, "tensor": 2}
+    assert dict(mesh.shape) == {"data": 2, "pipe": 1, "fsdp": 2,
+                                "expert": 1, "seq": 1, "tensor": 2}
     assert dp_axis_names(mesh) == ("data", "fsdp")
     assert batch_size_divisor(mesh) == 4
 
@@ -81,7 +81,7 @@ def test_build_with_multislice_fakes():
     ordered = order_devices_for_slices(devs, spec)
     import numpy as np
 
-    arr = np.asarray(ordered, dtype=object).reshape(2, 1, 1, 1, 4)
+    arr = np.asarray(ordered, dtype=object).reshape(2, 1, 1, 1, 1, 4)
     for data_coord in range(2):
         slices = {d.slice_index for d in arr[data_coord].flat}
         assert len(slices) == 1, "a data row must live in ONE slice"
@@ -94,4 +94,4 @@ def test_jax_devices_have_no_fake_attrs(devices8):
                for d in devices8)
     mesh = MeshSpec(data=4, tensor=2).build(devices8)
     assert jax.device_count() >= 8
-    assert mesh.devices.shape == (4, 1, 1, 1, 2)
+    assert mesh.devices.shape == (4, 1, 1, 1, 1, 2)
