@@ -13,7 +13,7 @@ rank=global_rank, shuffle per-epoch). TPU-first differences:
 from __future__ import annotations
 
 import os
-from typing import Any, Callable, Iterable, Iterator, Optional
+from typing import Any, Iterable, Iterator, Optional
 
 import numpy as np
 

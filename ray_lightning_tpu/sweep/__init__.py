@@ -27,6 +27,7 @@ from ray_lightning_tpu.sweep.session import (
     TrialStopped,
     get_checkpoint,
     get_trial_dir,
+    get_trial_hosts,
     get_trial_id,
     is_trial_session_enabled,
     report,
@@ -58,6 +59,7 @@ __all__ = [
     "get_trial_id",
     "get_trial_dir",
     "get_checkpoint",
+    "get_trial_hosts",
     "is_trial_session_enabled",
     "TrialStopped",
     "choice",

@@ -143,6 +143,17 @@ def report(metrics: Optional[Dict[str, Any]] = None,
     _ctx.report(merged, checkpoint=checkpoint)
 
 
+def get_trial_hosts() -> list:
+    """Cluster hosts borrowed by this trial (``sweep.run(hosts=...)``),
+    empty when the trial runs on the driver machine. The trial driver
+    itself runs on the first; a nested ``fit_distributed(hosts=
+    get_trial_hosts(), transport=...)`` spans all of them."""
+    import os
+
+    raw = os.environ.get("RLT_TRIAL_HOSTS", "")
+    return [h for h in raw.split(",") if h]
+
+
 def get_checkpoint() -> Optional[str]:
     """Checkpoint path to resume this trial from, or None on a fresh start.
 

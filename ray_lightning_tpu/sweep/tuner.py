@@ -50,6 +50,28 @@ class SweepError(RuntimeError):
     pass
 
 
+class _HostPool:
+    """Free-list of cluster hosts for trial placement (the reference let
+    Ray's scheduler put trial actors on any node; here placement is
+    explicit: each process-executor trial borrows `resources.hosts` hosts
+    for its lifetime and returns them)."""
+
+    def __init__(self, hosts):
+        self._free = list(hosts)
+        self._lock = threading.Lock()
+
+    def try_acquire(self, n: int):
+        with self._lock:
+            if len(self._free) < n:
+                return None
+            taken, self._free = self._free[:n], self._free[n:]
+            return taken
+
+    def release(self, hosts) -> None:
+        with self._lock:
+            self._free.extend(hosts)
+
+
 def _probe_device_count(executor: str) -> int:
     """Default chip-pool size.
 
@@ -109,10 +131,19 @@ class _ReportServer:
     """Driver-side end of the duplex report channel: accepts one socket
     per trial, answers every report with the scheduler's verdict."""
 
-    def __init__(self, handle_report: Callable[[str, Dict, Optional[str]], str]):
+    def __init__(self, handle_report: Callable[[str, Dict, Optional[str]], str],
+                 bind_all: bool = False):
         self._handle = handle_report
         self._authkey = secrets.token_bytes(32)
-        self._listener = Listener(("127.0.0.1", 0), authkey=self._authkey)
+        # remote trials must reach the channel: bind all interfaces and
+        # advertise the routable address (cf. WorkerGroup's listener)
+        self._listener = Listener(
+            ("0.0.0.0" if bind_all else "127.0.0.1", 0),
+            authkey=self._authkey,
+        )
+        from ray_lightning_tpu.runtime.group import routable_ip
+
+        self._advertise = routable_ip() if bind_all else "127.0.0.1"
         self._closed = False
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True
@@ -121,7 +152,7 @@ class _ReportServer:
 
     @property
     def address(self) -> tuple:
-        return self._listener.address
+        return (self._advertise, self._listener.address[1])
 
     @property
     def authkey_hex(self) -> str:
@@ -194,6 +225,8 @@ class TrialRunner:
         executor: str,
         trial_timeout: Optional[float],
         env: Optional[Dict[str, str]],
+        hosts: Optional[List[str]] = None,
+        transport=None,
     ):
         self.trainable = trainable
         self.metric = metric
@@ -205,6 +238,21 @@ class TrialRunner:
         self.executor = executor
         self.trial_timeout = trial_timeout
         self.env = env
+        self.transport = transport
+        self.host_pool: Optional[_HostPool] = None
+        if hosts:
+            if transport is None or not transport.is_remote:
+                # fail here, not inside the trial threads — a per-thread
+                # ValueError would strand `running` and deadlock the sweep
+                raise SweepError(
+                    "hosts= requires a remote transport (e.g. SSHTransport)"
+                )
+            if resources_per_trial.hosts > len(hosts):
+                raise SweepError(
+                    f"one trial needs {resources_per_trial.hosts} hosts but "
+                    f"only {len(hosts)} were given"
+                )
+            self.host_pool = _HostPool(hosts)
         cap = pool.max_concurrent(resources_per_trial)
         if cap < 1:
             raise SweepError(
@@ -357,7 +405,12 @@ class TrialRunner:
 
     # ------------------------------------------------------------- process
     def _run_process(self) -> None:
-        server = _ReportServer(self._handle_report)
+        server = _ReportServer(
+            self._handle_report,
+            # only trials actually placed off-machine need a routable
+            # report channel; otherwise stay on loopback
+            bind_all=self.host_pool is not None,
+        )
         terminal = (Trial.DONE, Trial.STOPPED)
         for t in self.trials:
             if t.status in terminal:
@@ -368,14 +421,23 @@ class TrialRunner:
         try:
             with self._cond:
                 while pending or running:
-                    while (pending and len(running) < self.max_concurrent
-                           and self.pool.try_acquire(self.resources)):
+                    while pending and len(running) < self.max_concurrent:
+                        if not self.pool.try_acquire(self.resources):
+                            break
+                        trial_hosts = None
+                        if self.host_pool is not None:
+                            trial_hosts = self.host_pool.try_acquire(
+                                self.resources.hosts
+                            )
+                            if trial_hosts is None:
+                                self.pool.release(self.resources)
+                                break
                         trial = pending.popleft()
                         running.add(trial.trial_id)
                         trial.status = Trial.RUNNING
                         threading.Thread(
                             target=self._trial_thread,
-                            args=(trial, server, running),
+                            args=(trial, server, running, trial_hosts),
                             daemon=True,
                         ).start()
                     self._cond.wait(timeout=1.0)
@@ -383,15 +445,27 @@ class TrialRunner:
             server.close()
 
     def _trial_thread(self, trial: Trial, server: _ReportServer,
-                      running: set) -> None:
-        group = WorkerGroup(
-            num_workers=1,
-            env={**(self.env or {}),
-                 "RLT_TRIAL_ID": trial.trial_id,
-                 "RLT_TRIAL_DIR": trial.trial_dir},
-            log_dir=os.path.join(trial.trial_dir, "logs"),
-        )
+                      running: set, trial_hosts=None) -> None:
+        group = None
         try:
+            env = {**(self.env or {}),
+                   "RLT_TRIAL_ID": trial.trial_id,
+                   "RLT_TRIAL_DIR": trial.trial_dir}
+            if trial_hosts:
+                # the FULL borrowed host set rides the env so the trial's
+                # nested fit_distributed can span all of them
+                # (sweep.get_trial_hosts())
+                env["RLT_TRIAL_HOSTS"] = ",".join(trial_hosts)
+            # cross-host trial placement: the trial-driver process runs on
+            # its first borrowed host (reference: Ray scheduled trial
+            # actors on any node); nested SPMD workers launch from there
+            group = WorkerGroup(
+                num_workers=1,
+                env=env,
+                log_dir=os.path.join(trial.trial_dir, "logs"),
+                hosts=trial_hosts[:1] if trial_hosts else None,
+                transport=self.transport if trial_hosts else None,
+            )
             group.start()
             [out] = group.run(
                 _trial_main,
@@ -412,8 +486,11 @@ class TrialRunner:
             log.error("trial %s infra failure:\n%s", trial.trial_id,
                       trial.error)
         finally:
-            group.shutdown()
+            if group is not None:
+                group.shutdown()
             self.pool.release(self.resources)
+            if trial_hosts and self.host_pool is not None:
+                self.host_pool.release(trial_hosts)
             self.scheduler.on_trial_complete(trial.trial_id)
             self._save_trial_state(trial)
             with self._cond:
@@ -448,6 +525,8 @@ def run(
     executor: str = "process",
     trial_timeout: Optional[float] = None,
     env: Optional[Dict[str, str]] = None,
+    hosts: Optional[List[str]] = None,
+    transport=None,
     seed: int = 0,
     raise_on_failed_trial: bool = True,
 ) -> ExperimentAnalysis:
@@ -462,6 +541,12 @@ def run(
     ``total_chips`` is the pool the reserve-don't-occupy accounting carves
     integral per-trial blocks out of; it defaults to the number of visible
     devices (one v5p slice on a pod, the virtual CPU mesh in tests).
+
+    ``hosts`` + a remote ``transport`` (runtime/transport.py) place each
+    process-executor trial on a borrowed cluster host for its lifetime —
+    the reference's "Tune schedules trial actors anywhere" capability;
+    concurrency is additionally bounded by ``len(hosts) //
+    resources_per_trial.hosts``. Ignored by the inline executor.
     """
     if mode not in ("min", "max"):
         raise ValueError("mode must be 'min' or 'max'")
@@ -490,6 +575,7 @@ def run(
         resources_per_trial=resources_per_trial, pool=pool,
         max_concurrent=max_concurrent, storage_dir=storage_dir,
         executor=executor, trial_timeout=trial_timeout, env=env,
+        hosts=hosts, transport=transport,
     )
     log.info("sweep %s: %d trials, <=%d concurrent, %d chips/trial of %d",
              name, len(runner.trials), runner.max_concurrent,

@@ -268,6 +268,83 @@ def test_process_trial_failure_is_fail_fast(tmp_path):
     assert "process kaboom" in t.error
 
 
+def test_trials_placed_on_cluster_hosts(tmp_path):
+    """Cross-host trial placement: each process-executor trial borrows a
+    host from the pool (via the remote transport's bootstrap path) and
+    returns it — the reference's 'Tune schedules trial actors on any
+    node' capability. 3 trials over 2 fake hosts forces reuse."""
+    from ray_lightning_tpu.runtime import LoopbackTransport
+
+    transport = LoopbackTransport()
+    analysis = sweep.run(
+        _fake_trainable,
+        config={"q": sweep.grid_search([0.2, 0.5, 0.8])},
+        metric="loss",
+        mode="min",
+        executor="process",
+        total_chips=8,
+        storage_dir=str(tmp_path),
+        trial_timeout=180.0,
+        hosts=["host-a", "host-b"],
+        transport=transport,
+        env={"JAX_PLATFORMS": "cpu"},
+    )
+    assert all(t.status == Trial.DONE for t in analysis.trials)
+    assert all(t.iterations == 12 for t in analysis.trials)
+    spawned_hosts = [h for h, _ in transport.spawned]
+    assert len(spawned_hosts) == 3
+    assert set(spawned_hosts) == {"host-a", "host-b"}  # pool reuse
+
+    with pytest.raises(sweep.SweepError, match="hosts"):
+        sweep.run(
+            _fake_trainable, config={}, metric="loss", executor="process",
+            total_chips=8, storage_dir=str(tmp_path / "x"),
+            resources_per_trial=sweep.TpuResources(chips=1, hosts=3),
+            hosts=["only-one"], transport=LoopbackTransport(),
+        )
+    # hosts without a remote transport must fail fast, not deadlock the
+    # scheduling loop
+    with pytest.raises(sweep.SweepError, match="remote transport"):
+        sweep.run(
+            _fake_trainable, config={}, metric="loss", executor="process",
+            total_chips=8, storage_dir=str(tmp_path / "y"),
+            hosts=["host-a"],
+        )
+
+
+def _hosts_aware_trainable(config):
+    from ray_lightning_tpu.sweep import get_trial_hosts
+
+    sweep.report(loss=0.0)
+    return {"hosts": get_trial_hosts()}
+
+
+def test_trial_sees_its_borrowed_host_set(tmp_path):
+    """A trial reserving N hosts runs its driver on the first and can
+    discover the full set (for nested cross-host fit_distributed)."""
+    from ray_lightning_tpu.runtime import LoopbackTransport
+
+    transport = LoopbackTransport()
+    analysis = sweep.run(
+        _hosts_aware_trainable,
+        config={},
+        metric="loss",
+        executor="process",
+        total_chips=8,
+        resources_per_trial=sweep.TpuResources(chips=2, hosts=2),
+        storage_dir=str(tmp_path),
+        trial_timeout=180.0,
+        hosts=["host-a", "host-b"],
+        transport=transport,
+        env={"JAX_PLATFORMS": "cpu"},
+    )
+    [t] = analysis.trials
+    assert t.status == Trial.DONE
+    assert t.result["hosts"] == ["host-a", "host-b"]
+    # the driver process itself was spawned on the first borrowed host
+    assert [h for h, _ in transport.spawned] == ["host-a"]
+
+
 # ------------------------------------------------------- trial resume
 
 
