@@ -159,22 +159,51 @@ class _ReportServer:
         return self._authkey.hex()
 
     def _accept_loop(self) -> None:
+        # Split accept from authentication: with the listener possibly on
+        # 0.0.0.0 for host-placed trials, a peer that stalls or resets
+        # mid-auth-challenge must neither wedge nor kill the acceptor —
+        # later trials still need to hand-shake. The socket-level accept
+        # (internal but stable: SocketListener.accept returns the raw
+        # Connection, no challenge) only ever blocks waiting for NEW
+        # connections; the blocking challenge runs on the per-connection
+        # thread, so a hostile peer wedges only its own thread.
         while not self._closed:
             try:
-                conn = self._listener.accept()
+                conn = self._listener._listener.accept()
             except OSError:
-                return  # listener closed
-            except Exception:  # noqa: BLE001 — e.g. AuthenticationError from
-                # a stray/malformed connection must not kill the acceptor;
-                # later trials still need to hand-shake.
+                if self._closed:
+                    return  # listener closed by close()
+                log.warning("report server: accept failed\n%s",
+                            traceback.format_exc(limit=2))
+                continue
+            except Exception:  # noqa: BLE001 — keep serving
                 if self._closed:
                     return
-                log.warning("report server: rejected connection\n%s",
+                log.warning("report server: accept failed\n%s",
                             traceback.format_exc(limit=2))
                 continue
             threading.Thread(
-                target=self._serve, args=(conn,), daemon=True
+                target=self._auth_and_serve, args=(conn,), daemon=True
             ).start()
+
+    def _auth_and_serve(self, conn) -> None:
+        from multiprocessing.connection import (
+            answer_challenge,
+            deliver_challenge,
+        )
+
+        try:
+            deliver_challenge(conn, self._authkey)
+            answer_challenge(conn, self._authkey)
+        except Exception:  # noqa: BLE001 — scanner / wrong key / reset
+            log.warning("report server: rejected connection\n%s",
+                        traceback.format_exc(limit=2))
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        self._serve(conn)
 
     def _serve(self, conn) -> None:
         try:

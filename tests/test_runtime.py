@@ -171,6 +171,35 @@ def test_remote_transport_two_hosts(tmp_path):
     assert transport.spawned == [("host-a", 0), ("host-b", 1)]
 
 
+def test_ssh_transport_command_and_bootstrap():
+    """SSHTransport mechanics without an ssh binary: the argv it would
+    exec, and the self-contained bootstrap program piped over stdin."""
+    from ray_lightning_tpu.runtime import SSHTransport
+    from ray_lightning_tpu.runtime.transport import _bootstrap_source
+
+    t = SSHTransport(ssh=("ssh", "-p", "2222"), remote_python="python3.11",
+                     pythonpath=("/opt/rlt",), env={"A": "1"})
+    assert t._command("10.0.0.7") == [
+        "ssh", "-p", "2222", "10.0.0.7", "--", "python3.11", "-u", "-",
+    ]
+    with pytest.raises(ValueError, match="host"):
+        t._command(None)
+
+    src = _bootstrap_source(("192.168.1.1", 5555, 3, 8),
+                            {"A": "1", "B": "x y"}, "deadbeef",
+                            ["/opt/rlt"])
+    compile(src, "<bootstrap>", "exec")  # must be a valid program
+    # env + authkey travel INSIDE the program (never on a command line)
+    assert "'RLT_WORKER_AUTHKEY': 'deadbeef'" in src
+    assert "'B': 'x y'" in src
+    assert "'/opt/rlt'" in src
+    # argv wiring for the embedded worker loop
+    assert "'192.168.1.1', '5555', '3', '8'" in src
+    # the worker source itself rides along, entrypoint guard included
+    assert "def main(argv)" in src
+    assert '__name__ == "__main__"' in src
+
+
 def test_remote_transport_failure_propagates(tmp_path):
     with WorkerGroup(
         hosts=["host-a", "host-b"],

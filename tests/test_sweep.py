@@ -345,6 +345,34 @@ def test_trial_sees_its_borrowed_host_set(tmp_path):
     assert [h for h, _ in transport.spawned] == ["host-a"]
 
 
+def test_report_server_survives_stalled_and_resetting_peers():
+    """The report channel may face a network (host-placed trials): a peer
+    that connects and stalls mid-challenge, or resets, must not wedge or
+    kill the acceptor — legitimate trials keep reporting."""
+    import socket
+    from multiprocessing.connection import Client
+
+    from ray_lightning_tpu.sweep.tuner import _ReportServer
+
+    server = _ReportServer(lambda tid, m, c: "continue")
+    try:
+        # stalled peer: connects, never answers the auth challenge
+        stall = socket.create_connection(server.address)
+        # a real client must still hand-shake and report
+        conn = Client(tuple(server.address),
+                      authkey=bytes.fromhex(server.authkey_hex))
+        conn.send(("report", "t1", {"m": 1.0}, None))
+        assert conn.recv() == "continue"
+        # resetting peer: connect + immediate close (RST mid-challenge)
+        socket.create_connection(server.address).close()
+        conn.send(("report", "t1", {"m": 2.0}, None))
+        assert conn.recv() == "continue"
+        conn.close()
+        stall.close()
+    finally:
+        server.close()
+
+
 # ------------------------------------------------------- trial resume
 
 
