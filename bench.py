@@ -135,12 +135,33 @@ def _measure(use_flash: bool, fused_ce: bool, batch: int, seq: int,
     return tps / dt, cfg
 
 
+def _probe_matmul_tflops(iters: int = 20) -> float:
+    """Bare 4096^3 bf16 matmul throughput — a model-free health probe.
+    Far below the spec-sheet peak (e.g. <100 on a 197-TFLOP/s v5e) means
+    the chip is externally contended; the model numbers in the same JSON
+    line should then be read as lower bounds, not capability."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((4096, 4096), jnp.bfloat16)
+    f = jax.jit(lambda a: a @ a)
+    r = f(x)
+    float(jax.device_get(r[0, 0]))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = f(r)
+    float(jax.device_get(r[0, 0]))
+    dt = (time.perf_counter() - t0) / iters
+    return 2 * 4096**3 / dt / 1e12
+
+
 def main() -> None:
     import jax
 
     device = jax.devices()[0]
     kind = device.device_kind
     peak_tflops = _PEAK_TFLOPS.get(kind, _DEFAULT_PEAK)
+    probe = _probe_matmul_tflops()
 
     # Tuned configs per leg, from the v5e sweeps (batch 2..16; chunk
     # 1k..24k; remat on/off x nothing/dots; scan on/off):
@@ -187,6 +208,7 @@ def main() -> None:
                 "assumed_peak_tflops": peak_tflops,
                 "device_kind": kind,
                 "flops_per_token": round(fpt / 1e9, 3),  # GFLOP
+                "probe_matmul_tflops": round(probe, 1),
                 "s4096_tokens_per_sec": round(s4k_tps, 1),
                 "s4096_mfu": round(s4k_mfu, 4),
                 "v128k_tokens_per_sec": round(v128k_tps, 1),

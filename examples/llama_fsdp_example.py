@@ -38,10 +38,27 @@ def main():
     p.add_argument("--fsdp", type=int, default=0,
                    help="fsdp degree (default: all remaining devices)")
     p.add_argument("--tensor", type=int, default=1, help="tensor-parallel degree")
+    p.add_argument("--pipe", type=int, default=1,
+                   help="pipeline stages (GPipe over the pipe mesh axis)")
+    p.add_argument("--microbatches", type=int, default=4,
+                   help="pipeline microbatches per step (with --pipe > 1)")
     p.add_argument("--seq-len", type=int, default=2048)
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--max-steps", type=int, default=50)
     p.add_argument("--lr", type=float, default=3e-4)
+    # perf levers (see README "Performance"; v5e sweep: remat off +
+    # unrolled layers is fastest when activations fit)
+    p.add_argument("--no-remat", action="store_true",
+                   help="disable rematerialization (more HBM, no "
+                        "backward recompute)")
+    p.add_argument("--remat-policy", choices=["nothing", "dots"],
+                   default="nothing")
+    p.add_argument("--no-scan-layers", action="store_true",
+                   help="unroll the layer stack (free schedule; pair "
+                        "with --no-remat)")
+    p.add_argument("--fused-ce", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="chunked lm_head+CE; auto = on for vocab >= 64k")
     p.add_argument("--smoke-test", action="store_true")
     args = p.parse_args()
 
@@ -78,8 +95,23 @@ def main():
         cfg = LlamaConfig.llama3_8b(use_flash=on_tpu,
                                     max_seq_len=args.seq_len)
 
-    fsdp = args.fsdp or max(1, n_dev // (args.data * args.tensor))
-    strategy = ShardedMesh(data=args.data, fsdp=fsdp, tensor=args.tensor)
+    import dataclasses
+
+    if args.no_scan_layers and args.pipe > 1:
+        p.error("--no-scan-layers conflicts with --pipe > 1 (the pipeline "
+                "stage-splits the scanned layer stack)")
+    cfg = dataclasses.replace(
+        cfg,
+        remat=not args.no_remat,
+        remat_policy=args.remat_policy,
+        scan_layers=not args.no_scan_layers,
+        fused_ce={"auto": None, "on": True, "off": False}[args.fused_ce],
+        pipeline_microbatches=args.microbatches if args.pipe > 1 else 0,
+    )
+
+    fsdp = args.fsdp or max(1, n_dev // (args.data * args.tensor * args.pipe))
+    strategy = ShardedMesh(data=args.data, fsdp=fsdp, tensor=args.tensor,
+                           pipe=args.pipe)
 
     seq_len = min(args.seq_len, cfg.max_seq_len)
     module = LlamaModule(cfg, lr=args.lr,

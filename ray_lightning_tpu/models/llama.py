@@ -81,6 +81,12 @@ class LlamaConfig:
     fused_ce: Optional[bool] = None
     #: logits tile height for the fused CE scan (C×V live logits memory)
     ce_chunk_tokens: int = 1024
+    #: >0 enables the GPipe decoder path (ops/pipeline.py) when the mesh
+    #: has pipe > 1: the scanned layer stack is stage-split over `pipe`
+    #: and this many microbatches flow through per step. Requires
+    #: scan_layers (the stacked param layout IS the pipeline's) and
+    #: composes with data/fsdp; tensor/seq stay off the pipeline path.
+    pipeline_microbatches: int = 0
 
     def __post_init__(self):
         if self.seq_parallel_mode not in ("ring", "ulysses"):
@@ -92,6 +98,16 @@ class LlamaConfig:
             raise ValueError(
                 f"remat_policy must be 'nothing' or 'dots', got "
                 f"{self.remat_policy!r}"
+            )
+        if self.pipeline_microbatches > 0 and not self.scan_layers:
+            raise ValueError(
+                "pipeline_microbatches requires scan_layers=True (the "
+                "stacked layer layout is what the pipeline stage-splits)"
+            )
+        if self.pipeline_microbatches > 0 and self.seq_parallel:
+            raise ValueError(
+                "pipeline_microbatches and seq_parallel are mutually "
+                "exclusive (the pipeline path runs attention per stage)"
             )
 
     @property
@@ -287,8 +303,11 @@ class Llama(nn.Module):
 
 
 def _stacked(spec: P, stacked: bool) -> P:
-    """Prepend the scan layer axis (replicated) to a per-layer spec."""
-    return P(None, *spec) if stacked else spec
+    """Prepend the scan layer axis to a per-layer spec. The layer axis
+    carries `pipe` — on meshes without pipeline parallelism the strategy
+    drops the size-1 axis (Strategy._adapt_spec) and it is replicated as
+    before; with pipe > 1 each stage group owns its contiguous block."""
+    return P("pipe", *spec) if stacked else spec
 
 
 def llama_param_specs(cfg: LlamaConfig) -> Dict[str, P]:
@@ -466,19 +485,75 @@ class LlamaModule(TpuModule):
             return self.cfg.fused_ce
         return self.cfg.vocab_size >= 2**16
 
+    def _use_pipeline(self) -> bool:
+        return (self.cfg.pipeline_microbatches > 0
+                and self.mesh is not None
+                and self.mesh.shape.get("pipe", 1) > 1)
+
+    def _pipelined_hidden(self, params, tokens):
+        """GPipe decoder path: the SAME stacked `layers` params the scan
+        path trains, stage-split over the mesh's `pipe` axis
+        (ops/pipeline.py) — embedding / final norm / lm_head run outside
+        the pipeline, numerics identical to the scan path."""
+        from ray_lightning_tpu.ops.pipeline import gpipe_apply
+
+        cfg = self.cfg
+        if any(self.mesh.shape.get(ax, 1) > 1 for ax in ("tensor", "seq")):
+            raise ValueError(
+                "the pipeline path composes with data/fsdp only; drop "
+                "tensor/seq from the mesh or disable "
+                "pipeline_microbatches"
+            )
+        emb = params["tok_embed"]["embedding"]
+        x = jnp.take(emb, tokens, axis=0).astype(cfg.dtype)
+        cos, sin = rope_frequencies(
+            cfg.head_dim, cfg.max_seq_len, cfg.rope_theta, dtype=jnp.float32
+        )
+        cos, sin = cos[: tokens.shape[1]], sin[: tokens.shape[1]]
+        block = LlamaBlock(cfg, None)
+
+        def stage_fn(lp, h, cos, sin):
+            return block.apply({"params": lp}, h, cos, sin)[0]
+
+        policy = {
+            "nothing": jax.checkpoint_policies.nothing_saveable,
+            "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        }[cfg.remat_policy]
+        h = gpipe_apply(
+            stage_fn, params["layers"], x, self.mesh,
+            microbatches=cfg.pipeline_microbatches,
+            remat=cfg.remat, remat_policy=policy, extra=(cos, sin),
+        )
+        return rms_norm(h, params["final_norm"], cfg.norm_eps)
+
     def _loss(self, params, inputs, targets, mask):
-        if self._use_fused_ce():
-            hidden = self.apply(params, inputs, return_hidden=True)
-            if self.cfg.tie_embeddings:
+        cfg = self.cfg
+        use_pipe = self._use_pipeline()
+        use_fused = self._use_fused_ce()
+        if not (use_pipe or use_fused):
+            return cross_entropy_loss(
+                self.apply(params, inputs), targets, mask)
+        hidden = (self._pipelined_hidden(params, inputs) if use_pipe
+                  else self.apply(params, inputs, return_hidden=True))
+        if use_fused:
+            if cfg.tie_embeddings:
                 w = params["tok_embed"]["embedding"].T
             else:
                 w = params["lm_head"]["kernel"]
             return fused_cross_entropy(
                 hidden, w, targets, mask,
-                chunk_tokens=self.cfg.ce_chunk_tokens,
-                compute_dtype=self.cfg.dtype,
+                chunk_tokens=cfg.ce_chunk_tokens,
+                compute_dtype=cfg.dtype,
             )
-        logits = self.apply(params, inputs)
+        # materialized logits from the pipelined hidden states — the same
+        # math the flax head performs: cfg.dtype matmul (Embed.attend
+        # promotes to cfg.dtype for tied weights too), f32 loss upcast
+        if cfg.tie_embeddings:
+            w = params["tok_embed"]["embedding"].T
+        else:
+            w = params["lm_head"]["kernel"]
+        logits = (hidden.astype(cfg.dtype) @ w.astype(cfg.dtype)
+                  ).astype(jnp.float32)
         return cross_entropy_loss(logits, targets, mask)
 
     def training_step(self, params, batch, rng):

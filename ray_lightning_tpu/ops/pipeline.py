@@ -42,6 +42,7 @@ def gpipe_apply(
     microbatches: int,
     axis_name: str = "pipe",
     remat: bool = False,
+    remat_policy: Optional[Callable] = None,
     extra: tuple = (),
 ) -> jnp.ndarray:
     """Apply L stacked layers to ``x``, stage-split over ``axis_name``.
@@ -59,7 +60,8 @@ def gpipe_apply(
     With pipe size 1 this degrades to a plain layer scan.
     """
     pipe = mesh.shape.get(axis_name, 1)
-    body = jax.checkpoint(stage_fn) if remat else stage_fn
+    body = (jax.checkpoint(stage_fn, policy=remat_policy) if remat
+            else stage_fn)
 
     if pipe <= 1:
         def seq_body(h, lp):

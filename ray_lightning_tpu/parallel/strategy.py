@@ -276,11 +276,13 @@ class FSDP(Strategy):
 
 
 class ShardedMesh(Strategy):
-    """Explicit N-D mesh strategy composing dp × fsdp × tensor × seq (× expert).
+    """Explicit N-D mesh strategy composing dp × fsdp × tensor × seq
+    (× expert × pipe).
 
     The general form: `ShardedMesh(data=2, fsdp=2, tensor=2)`. Tensor-axis
     placement comes from the module's `param_specs` hook (Megatron-style
-    column/row splits are module knowledge); fsdp placement is automatic.
+    column/row splits are module knowledge); fsdp placement is automatic;
+    `pipe` feeds the GPipe building block (ops/pipeline.py).
     """
 
     def __init__(

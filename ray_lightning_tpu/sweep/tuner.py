@@ -167,20 +167,19 @@ class _ReportServer:
         # Connection, no challenge) only ever blocks waiting for NEW
         # connections; the blocking challenge runs on the per-connection
         # thread, so a hostile peer wedges only its own thread.
+        import time as _time
+
         while not self._closed:
             try:
                 conn = self._listener._listener.accept()
-            except OSError:
+            except Exception:  # noqa: BLE001 — keep serving
                 if self._closed:
                     return  # listener closed by close()
                 log.warning("report server: accept failed\n%s",
                             traceback.format_exc(limit=2))
-                continue
-            except Exception:  # noqa: BLE001 — keep serving
-                if self._closed:
-                    return
-                log.warning("report server: accept failed\n%s",
-                            traceback.format_exc(limit=2))
+                # bound a persistent failure (e.g. EMFILE) to a warm
+                # trickle instead of a hot busy-loop flooding the log
+                _time.sleep(0.2)
                 continue
             threading.Thread(
                 target=self._auth_and_serve, args=(conn,), daemon=True

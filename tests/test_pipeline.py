@@ -104,6 +104,109 @@ def test_gpipe_validates_divisibility(devices8):
                         microbatches=2)
 
 
+# ------------------------------------------------- Llama pipeline path
+
+
+def _llama_cfg(**kw):
+    from ray_lightning_tpu.models.llama import LlamaConfig
+
+    return LlamaConfig(
+        vocab_size=128, dim=32, n_layers=4, n_heads=2, n_kv_heads=1,
+        hidden_dim=64, max_seq_len=64, use_flash=False, dtype=jnp.float32,
+        remat=False, **kw)
+
+
+@pytest.mark.parametrize("fused", [False, True])
+def test_llama_pipeline_matches_scan_path(devices8, fused):
+    """The GPipe decoder path trains the SAME stacked params as the scan
+    path: losses and grads must agree (pipeline is a schedule)."""
+    from ray_lightning_tpu.models.llama import LlamaModule
+
+    mesh = make_mesh(data=2, pipe=4, devices=devices8)
+    batch = {"tokens": (np.arange(8 * 17, dtype=np.int32)
+                        .reshape(8, 17) % 128)}
+
+    m_pipe = LlamaModule(_llama_cfg(pipeline_microbatches=2,
+                                    fused_ce=fused, ce_chunk_tokens=16))
+    m_pipe.mesh = mesh
+    m_pipe.setup()
+    params = m_pipe.init_params(jax.random.key(0), batch)
+    i, t, msk = m_pipe._split(batch)
+
+    m_scan = LlamaModule(_llama_cfg(fused_ce=fused, ce_chunk_tokens=16))
+    m_scan.setup()
+
+    with mesh:
+        assert m_pipe._use_pipeline()
+        loss_p, grads_p = jax.value_and_grad(
+            lambda p: m_pipe._loss(p, i, t, msk))(params)
+    loss_s, grads_s = jax.value_and_grad(
+        lambda p: m_scan._loss(p, i, t, msk))(params)
+    np.testing.assert_allclose(np.asarray(loss_p), np.asarray(loss_s),
+                               rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(grads_p), jax.tree.leaves(grads_s)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=1e-6)
+
+
+def test_llama_pipeline_tied_bf16_matches_scan(devices8):
+    """Tied embeddings at bf16: the pipeline head must use the same
+    cfg.dtype matmul as flax's Embed.attend (an f32 head would silently
+    diverge — and be slower)."""
+    from ray_lightning_tpu.models.llama import LlamaConfig, LlamaModule
+
+    mesh = make_mesh(data=2, pipe=4, devices=devices8)
+    base = dict(vocab_size=128, dim=32, n_layers=4, n_heads=2, n_kv_heads=1,
+                hidden_dim=64, max_seq_len=64, use_flash=False, remat=False,
+                dtype=jnp.bfloat16, tie_embeddings=True, fused_ce=False)
+    batch = {"tokens": (np.arange(8 * 17, dtype=np.int32)
+                        .reshape(8, 17) % 128)}
+
+    m_pipe = LlamaModule(LlamaConfig(**base, pipeline_microbatches=2))
+    m_pipe.mesh = mesh
+    m_pipe.setup()
+    params = m_pipe.init_params(jax.random.key(0), batch)
+    i, t, msk = m_pipe._split(batch)
+    with mesh:
+        loss_p = float(m_pipe._loss(params, i, t, msk))
+    m_scan = LlamaModule(LlamaConfig(**base))
+    m_scan.setup()
+    loss_s = float(m_scan._loss(params, i, t, msk))
+    np.testing.assert_allclose(loss_p, loss_s, rtol=2e-2)
+
+
+def test_llama_pipeline_trains_through_trainer(devices8, tmp_path):
+    from ray_lightning_tpu.models.llama import LlamaModule
+
+    cfg = _llama_cfg(pipeline_microbatches=2)
+    module = LlamaModule(cfg, lr=1e-3, warmup_steps=1, total_steps=4)
+    rng = np.random.default_rng(0)
+    data = {"tokens": rng.integers(0, cfg.vocab_size, (16, 33))
+            .astype(np.int32)}
+    strategy = ShardedMesh(data=2, pipe=4, devices=devices8,
+                           min_shard_size=1)
+    trainer = Trainer(strategy=strategy, max_epochs=1,
+                      limit_train_batches=2,
+                      default_root_dir=str(tmp_path),
+                      enable_checkpointing=False,
+                      enable_progress_bar=False, seed=0)
+    trainer.fit(module, DataLoader(data, batch_size=8))
+    assert trainer.global_step == 2
+    # the scanned layer stack is stage-sharded over pipe
+    spec = trainer.state.params["layers"]["wqkv"]["kernel"].sharding.spec
+    assert "pipe" in str(spec)
+    assert float(trainer.callback_metrics["loss"]) > 0
+
+
+def test_llama_pipeline_config_validation():
+    from ray_lightning_tpu.models.llama import LlamaConfig
+
+    with pytest.raises(ValueError, match="scan_layers"):
+        _llama_cfg(pipeline_microbatches=2, scan_layers=False)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        LlamaConfig.tiny(pipeline_microbatches=2, seq_parallel=True)
+
+
 # ---------------------------------------------------- Trainer integration
 
 
