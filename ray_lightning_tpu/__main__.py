@@ -1,0 +1,88 @@
+"""``python -m ray_lightning_tpu`` — environment/topology doctor.
+
+Pod-debugging UX the reference delegated to Ray's dashboard: one command
+answers "what does THIS process see" — backend, process/device topology
+(the rank helpers of SURVEY §5.8), per-device kind/slice, and optionally
+a bare-matmul throughput probe that makes external contention on shared
+chips visible (same probe bench.py embeds in its JSON).
+
+    python -m ray_lightning_tpu            # topology, no device touch
+    python -m ray_lightning_tpu --probe    # + matmul TFLOP/s
+    python -m ray_lightning_tpu --json     # machine-readable
+"""
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def collect(probe: bool = False) -> dict:
+    import jax
+
+    devices = jax.devices()
+    info = {
+        "package": "ray_lightning_tpu 0.1.0",
+        "jax": jax.__version__,
+        "backend": jax.default_backend(),
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": jax.local_device_count(),
+        "global_devices": jax.device_count(),
+        "devices": [
+            {
+                "id": d.id,
+                "kind": d.device_kind,
+                "platform": d.platform,
+                "slice_index": getattr(d, "slice_index", None),
+            }
+            for d in devices[:16]
+        ],
+    }
+    if len(devices) > 16:
+        info["devices_truncated"] = len(devices) - 16
+    if probe:
+        import time
+
+        import jax.numpy as jnp
+
+        x = jnp.ones((4096, 4096), jnp.bfloat16)
+        f = jax.jit(lambda a: a @ a)
+        r = f(x)
+        float(jax.device_get(r[0, 0]))
+        t0 = time.perf_counter()
+        for _ in range(10):
+            r = f(r)
+        float(jax.device_get(r[0, 0]))
+        dt = (time.perf_counter() - t0) / 10
+        info["probe_matmul_tflops"] = round(2 * 4096**3 / dt / 1e12, 1)
+    return info
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("python -m ray_lightning_tpu")
+    p.add_argument("--probe", action="store_true",
+                   help="run a bare-matmul throughput probe (touches and "
+                        "may briefly occupy the accelerator)")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    args = p.parse_args(argv)
+    info = collect(probe=args.probe)
+    if args.as_json:
+        print(json.dumps(info))
+        return 0
+    print(f"{info['package']}  (jax {info['jax']}, "
+          f"backend {info['backend']})")
+    print(f"process {info['process_index']}/{info['process_count']}  "
+          f"devices {info['local_devices']} local / "
+          f"{info['global_devices']} global")
+    for d in info["devices"]:
+        sl = f" slice={d['slice_index']}" if d["slice_index"] is not None else ""
+        print(f"  [{d['id']}] {d['kind']} ({d['platform']}){sl}")
+    if info.get("devices_truncated"):
+        print(f"  ... and {info['devices_truncated']} more")
+    if "probe_matmul_tflops" in info:
+        print(f"probe: {info['probe_matmul_tflops']} TFLOP/s bf16 matmul")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

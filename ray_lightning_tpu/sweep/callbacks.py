@@ -138,10 +138,18 @@ class TuneReportCheckpointCallback(TuneReportCallback):
         filename: str = "checkpoint",
         on: str = "validation_end",
         dirpath: Optional[str] = None,
+        keep_last_n: Optional[int] = None,
     ):
         super().__init__(metrics=metrics, on=on)
         self.filename = filename
         self.dirpath = dirpath
+        #: retention: keep only the newest N checkpoints this callback
+        #: wrote (None = keep all). A per-epoch cadence over a long sweep
+        #: otherwise fills the disk with full model+optimizer states.
+        if keep_last_n is not None and keep_last_n < 1:
+            raise ValueError(f"keep_last_n must be >= 1, got {keep_last_n}")
+        self.keep_last_n = keep_last_n
+        self._written: List[str] = []
 
     def _resolve_dir(self, trainer) -> str:
         if self.dirpath:
@@ -158,4 +166,29 @@ class TuneReportCheckpointCallback(TuneReportCallback):
         path = os.path.join(
             base, f"{self.filename}_{trainer.global_step:08d}"
         )
-        return trainer.save_checkpoint(path)
+        out = trainer.save_checkpoint(path)
+        if self.keep_last_n is not None:
+            # re-saving an existing path (e.g. a zero-step epoch writing
+            # the same global_step) must replace, not duplicate, its
+            # entry — a duplicate would let prune delete the live newest
+            self._written = [p for p in self._written if p != out]
+            self._written.append(out)
+            self._prune()
+        return out
+
+    def _prune(self) -> None:
+        """Delete this callback's oldest checkpoints beyond keep_last_n.
+        Only rank 0 removes files (a sharded write is collective, but the
+        dirs live on a shared filesystem); only paths THIS callback wrote
+        are ever touched. _written mutates identically on every rank so
+        the bookkeeping stays in step."""
+        import jax
+
+        from ray_lightning_tpu.core.callbacks import _remove_checkpoint
+
+        while len(self._written) > self.keep_last_n:
+            victim = self._written.pop(0)
+            if jax.process_index() != 0:
+                continue
+            _remove_checkpoint(victim)
+            log.info("pruned sweep checkpoint %s", victim)

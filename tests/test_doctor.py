@@ -1,0 +1,40 @@
+"""The `python -m ray_lightning_tpu` doctor: topology report correctness
+(run in a subprocess so it controls its own backend)."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_doctor_collect_reports_topology():
+    code = (
+        "from ray_lightning_tpu import simulate_cpu_devices\n"
+        "simulate_cpu_devices(8)\n"
+        "import json\n"
+        "from ray_lightning_tpu.__main__ import collect\n"
+        "print(json.dumps(collect()))\n"
+    )
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=180, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    info = json.loads(out.stdout.strip().splitlines()[-1])
+    assert info["backend"] == "cpu"
+    assert info["local_devices"] == 8
+    assert len(info["devices"]) == 8
+    assert info["devices"][0]["platform"] == "cpu"
+    assert info["process_count"] == 1
+
+
+def test_doctor_main_human_output(capsys):
+    from ray_lightning_tpu.__main__ import main
+
+    assert main([]) == 0
+    out = capsys.readouterr().out
+    assert "ray_lightning_tpu" in out
+    assert "devices" in out

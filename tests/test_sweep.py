@@ -345,6 +345,41 @@ def test_trial_sees_its_borrowed_host_set(tmp_path):
     assert [h for h, _ in transport.spawned] == ["host-a"]
 
 
+def _retention_trainable(config):
+    from ray_lightning_tpu import DataLoader, Trainer
+    from ray_lightning_tpu.sweep import TuneReportCheckpointCallback
+    from tests.utils import BoringModel, random_dataset
+
+    trainer = Trainer(
+        max_epochs=4,
+        callbacks=[TuneReportCheckpointCallback(on="train_epoch_end",
+                                                keep_last_n=2)],
+        enable_checkpointing=False,
+        enable_progress_bar=False,
+        seed=0,
+    )
+    trainer.fit(BoringModel(), DataLoader(random_dataset(64), batch_size=32))
+    return "ok"
+
+
+def test_sweep_checkpoint_retention(tmp_path):
+    """keep_last_n prunes the callback's older sweep checkpoints so long
+    sweeps don't fill the disk; the newest (the resume source,
+    trial.checkpoints[-1]) always survives."""
+    analysis = sweep.run(
+        _retention_trainable, config={}, metric="loss", executor="inline",
+        total_chips=8, storage_dir=str(tmp_path),
+    )
+    [t] = analysis.trials
+    assert t.status == Trial.DONE
+    assert len(t.checkpoints) == 4  # all four were registered...
+    import os as _os
+
+    existing = [c for c in t.checkpoints if _os.path.isdir(c)]
+    assert existing == t.checkpoints[-2:]  # ...but only the newest 2 kept
+    assert t.last_checkpoint in existing
+
+
 def test_report_server_survives_stalled_and_resetting_peers():
     """The report channel may face a network (host-placed trials): a peer
     that connects and stalls mid-challenge, or resets, must not wedge or
