@@ -44,22 +44,19 @@ def make_trainer():
 
 
 def make_data():
-    import jax
-
     from ray_lightning_tpu import DataLoader
 
     rng = np.random.default_rng(0)
     centers = rng.normal(size=(4, 16)) * 3
     y = rng.integers(0, 4, size=512)
     x = (centers[y] + rng.normal(size=(512, 16)) * 0.1).astype(np.float32)
-    # each host loads ITS shard of the global batch (the
-    # DistributedSampler analog, reference ray_ddp.py:293-303)
-    train = DataLoader({"x": x, "y": y}, batch_size=32, shuffle=True,
-                       num_shards=jax.process_count(),
-                       shard_index=jax.process_index())
-    val = DataLoader({"x": x, "y": y}, batch_size=32,
-                     num_shards=jax.process_count(),
-                     shard_index=jax.process_index())
+    # No shard arguments: the distributed launcher FORCES per-host
+    # sharding onto every loader (the reference's injected
+    # DistributedSampler, ray_ddp.py:293-303) — each host yields its own
+    # rows of the global batch; passing matching num_shards/shard_index
+    # manually is accepted, disagreeing ones are a hard error.
+    train = DataLoader({"x": x, "y": y}, batch_size=32, shuffle=True)
+    val = DataLoader({"x": x, "y": y}, batch_size=32)
     return train, val
 
 

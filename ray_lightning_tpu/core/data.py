@@ -36,6 +36,7 @@ class DataLoader:
         shard_index: int = 0,
         prefetch: bool = False,
         num_workers: Optional[int] = None,
+        sharded_externally: bool = False,
     ):
         self.data = data
         self.batch_size = batch_size
@@ -45,6 +46,11 @@ class DataLoader:
         self.num_shards = num_shards
         self.shard_index = shard_index
         self.prefetch = prefetch
+        #: declares that ``data`` already holds/yields just THIS
+        #: process's rows (per-host files, a pre-split array, a
+        #: sharding-aware stream) — `ensure_sharded` then leaves the
+        #: loader alone instead of injecting num_shards on top.
+        self.sharded_externally = sharded_externally
         self._num_workers = num_workers
         self._batcher = None
         self._epoch = 0
@@ -175,3 +181,62 @@ def resolve_loaders(module, data) -> tuple:
         data.setup()
         return data.train_dataloader(), data.val_dataloader()
     return data, None
+
+
+def ensure_sharded(loader: Any, num_shards: int, shard_index: int,
+                   stage: str = "train") -> Any:
+    """Force distributed shard semantics onto a loader — the rebuild of
+    the reference's *forced* DistributedSampler (ray_ddp.py:293-303:
+    num_replicas=num_workers, rank=global_rank, injected whether or not
+    the user thought about it), because the failure mode of forgetting is
+    silent: `make_array_from_process_local_data` happily assembles a
+    global batch where every host contributed identical rows — duplicated
+    samples, no error, wrong training.
+
+    Returns the loader with ``num_shards``/``shard_index`` set. Raises on
+    anything it cannot make safe:
+      * a `DataLoader` already sharded differently (user misconfiguration
+        — two sources of truth for the shard layout);
+      * a streaming `DataLoader` whose callable we cannot reach into,
+        unless constructed with ``sharded_externally=True``;
+      * a plain iterable (list/generator), which has no shard handle at
+        all — wrap it in a `DataLoader`.
+    """
+    if loader is None or num_shards <= 1:
+        return loader
+    if isinstance(loader, DataLoader):
+        if loader.sharded_externally:
+            # The user declares this loader already yields only THIS
+            # process's rows (its own per-host files, a pre-split array,
+            # a sharding-aware stream) — honored for array-backed and
+            # streaming sources alike; injecting num_shards on top would
+            # silently train on a 1/world slice of each host's data.
+            return loader
+        if loader._stream:
+            raise ValueError(
+                f"streaming {stage} DataLoader in a {num_shards}-process "
+                "job: the data callable is opaque, so per-process "
+                "sharding cannot be injected. Make the callable yield "
+                "only this process's rows (jax.process_index()) and "
+                "construct the DataLoader with sharded_externally=True."
+            )
+        if loader.num_shards == 1:
+            loader.num_shards = num_shards
+            loader.shard_index = shard_index
+            return loader
+        if (loader.num_shards == num_shards
+                and loader.shard_index == shard_index):
+            return loader  # user already sharded it correctly — idempotent
+        raise ValueError(
+            f"{stage} DataLoader is sharded {loader.shard_index}/"
+            f"{loader.num_shards} but this job runs as process "
+            f"{shard_index}/{num_shards}. Drop the manual num_shards/"
+            "shard_index arguments (the distributed launcher injects "
+            "them) or make them match the job."
+        )
+    raise TypeError(
+        f"{stage} data in a {num_shards}-process job must be a "
+        f"ray_lightning_tpu DataLoader (got {type(loader).__name__}): a "
+        "plain iterable has no shard handle, so every process would "
+        "train on identical rows. Wrap the data in DataLoader(...)."
+    )

@@ -66,12 +66,32 @@ def _job_remote(
     import jax
     import numpy as np
 
+    from ray_lightning_tpu.core.data import DataModule, ensure_sharded
+
     module = module_factory()
     trainer = trainer_factory()
     data = data_factory()
     rank = jax.process_index()
+    world = jax.process_count()
+
+    if isinstance(data, DataModule):
+        # normalize here (not in trainer.fit) so the per-stage loaders are
+        # visible for shard injection below.
+        data.setup()
+        if kind == "fit":
+            data = (data.train_dataloader(), data.val_dataloader())
+        else:
+            data = {
+                "validate": data.val_dataloader,
+                "test": data.test_dataloader,
+                "predict": data.predict_dataloader,
+            }[kind]()
 
     if kind != "fit":
+        # Forced shard semantics for the eval family too (the reference
+        # injects its sampler per-stage — val/test/predict loaders alike,
+        # ray_ddp.py:293-303 via PTL's per-stage dataloader hooks).
+        data = ensure_sharded(data, world, rank, stage=kind)
         # Eval-family jobs: weights come from the factory or a checkpoint
         # (the reference's load-then-predict leg, tests/test_ddp.py:79-113).
         # load_checkpoint gathers to host — the small/medium-model path;
@@ -106,6 +126,12 @@ def _job_remote(
     if not isinstance(data, tuple):
         data = (data, None)
     train_data, val_data = data
+    # The reference's forcing guarantee (ray_ddp.py:293-303): in a
+    # multi-process job, forgetting shard arguments is impossible — the
+    # launcher injects them, and unshardable inputs are a hard error, not
+    # silently-duplicated per-host batches.
+    train_data = ensure_sharded(train_data, world, rank, stage="train")
+    val_data = ensure_sharded(val_data, world, rank, stage="val")
     trainer.fit(module, train_data, val_data, ckpt_path=ckpt_path)
 
     out_ckpt = None

@@ -95,6 +95,45 @@ class BoringModel(TpuModule):
         self.hook_calls.append("on_load_checkpoint")
 
 
+class IdSumModel(TpuModule):
+    """Duplicated-rows detector for the forced-sharding tests: x[:, 0]
+    carries the row id, and every step logs (a) `dup_rows` — the number
+    of equal adjacent ids after sorting the GLOBAL batch's ids (0 iff
+    every host contributed distinct rows), and (b) `id_sum` — the global
+    batch's id total. The analog of the reference's worker-side
+    DistributedSampler assertions (reference tests/test_ddp.py:44-76)."""
+
+    def __init__(self, lr: float = 1e-2):
+        super().__init__()
+        self.save_hyperparameters(lr=lr)
+        self.lr = lr
+
+    def configure_model(self):
+        return _Boring()
+
+    def configure_optimizers(self):
+        return optax.sgd(self.lr)
+
+    def _id_metrics(self, batch):
+        ids = jnp.sort(batch["x"][:, 0])
+        dups = (ids[1:] == ids[:-1]).sum().astype(jnp.float32)
+        return dups, ids.sum()
+
+    def training_step(self, params, batch, rng):
+        logits = self.apply(params, batch["x"])
+        labels = jax.nn.one_hot(batch["y"], 2)
+        loss = optax.softmax_cross_entropy(logits, labels).mean()
+        dups, id_sum = self._id_metrics(batch)
+        self.log("dup_rows", dups)
+        self.log("id_sum", id_sum)
+        self.log("train_loss", loss)
+        return loss
+
+    def validation_step(self, params, batch):
+        dups, id_sum = self._id_metrics(batch)
+        return {"val_dup_rows": dups, "val_id_sum": id_sum}
+
+
 class _MLP(nn.Module):
     """3-layer MLP, the reference's LightningMNISTClassifier shape
     (tests/utils.py:96-120): 128 → 256 → num_classes."""
