@@ -27,12 +27,25 @@ Every transport returns a ``subprocess.Popen``-compatible handle
 (poll/kill/wait/returncode); for SSH the handle is the local ssh client
 process — killing it drops the stdin/stdout pipes, which the worker
 observes as EOF and the driver's pump reports fail-fast.
+
+SECURITY: the driver⇄worker control channel carries pickled closures over
+TCP, authenticated by a per-launch random 256-bit authkey (the
+``multiprocessing.connection`` HMAC challenge) but NOT encrypted — the
+challenge authenticates connection setup only. The listener binds the
+specific cluster-facing interface (never 0.0.0.0 unless the advertise
+address is non-local, see WorkerGroup.start), and the SSH bootstrap keeps
+the authkey off argv/process listings; but an attacker who can inject
+into the established TCP stream on the cluster network can deliver a
+pickle payload. Run on a trusted/isolated cluster network (the same
+assumption Ray's GCS/object-store channels make), or tunnel the control
+channel itself (e.g. ssh -L port forwarding per host) on anything less.
 """
 from __future__ import annotations
 
 import os
 import subprocess
 import sys
+import threading
 from typing import Dict, Optional, Sequence
 
 _WORKER_PATH = os.path.join(os.path.dirname(__file__), "worker.py")
@@ -179,8 +192,21 @@ class SSHTransport(Transport):
             )
         finally:
             logf.close()
-        proc.stdin.write(source.encode())
-        proc.stdin.close()
+        # Feed the bootstrap on a helper thread: a wedged ssh that never
+        # drains stdin must surface through the group's start_timeout as a
+        # no-hello spawn failure, not block the driver inside write()
+        # before the timeout machinery even engages (the source can
+        # exceed the OS pipe buffer).
+        def _feed(stdin, data):
+            try:
+                stdin.write(data)
+                stdin.close()
+            except (BrokenPipeError, OSError):
+                pass  # dead ssh: poll()/log tail report it
+
+        threading.Thread(
+            target=_feed, args=(proc.stdin, source.encode()), daemon=True
+        ).start()
         return proc
 
 
@@ -193,6 +219,11 @@ class LoopbackTransport(SSHTransport):
     explicit env propagation, routable listener/coordinator addresses —
     on one machine, and handy as a dev-box smoke of an SSH deployment.
     """
+
+    #: remote semantics, but the processes really are local — loopback is
+    #: a legitimate driver address here (WorkerGroup's no-default-route
+    #: fail-fast is for transports whose workers live on OTHER machines)
+    allows_loopback = True
 
     #: env vars a login shell would have anyway; everything else is dropped
     _KEEP = ("PATH", "HOME", "TMPDIR", "LANG", "LC_ALL", "USER", "SHELL")

@@ -20,6 +20,23 @@ Wire protocol (all messages are tuples, first element is the command):
     ("exec", tid, blob)        blob = cloudpickle((fn, args, kwargs));
                                reply is ("result", tid, blob) or
                                ("error", tid, traceback_str)
+    ("exec2", tid, digest, blob_or_None, extra_blob)
+                               ship-once execution (the reference's
+                               ray.put fan-out, ray_ddp.py:168-171):
+                               blob = cloudpickle((fn, shared_args,
+                               kwargs)) on first sight of `digest`, None
+                               when this worker already cached it;
+                               extra_blob = cloudpickle(per_rank_args).
+                               Runs fn(*shared_args, *per_rank_args,
+                               **kwargs). If blob is None but the digest
+                               is NOT cached (eviction, earlier parse
+                               failure), the worker replies
+                               ("need_blob", tid, digest) and the driver
+                               resends with the payload — cache desyncs
+                               self-heal. NOTE the cached (fn, args)
+                               objects are REUSED across calls — like a
+                               plasma-store value, they must not rely on
+                               call-local mutation.
     ("shutdown",)              reply ("bye", rank), then exit 0
   worker -> driver:
     ("hello", rank, info)      sent once on connect
@@ -39,6 +56,13 @@ import cloudpickle
 
 
 def _node_ip() -> str:
+    """This worker host's address as the other hosts see it. RLT_NODE_IP
+    overrides (the multi-NIC escape hatch — deliverable per host through
+    the transport env); otherwise the default-route interface via the
+    UDP-connect trick (no packet is sent)."""
+    override = os.environ.get("RLT_NODE_IP")
+    if override:
+        return override
     try:
         s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         s.connect(("8.8.8.8", 80))
@@ -84,6 +108,12 @@ def _bind_session(channel: _WorkerChannel) -> None:
     )
 
 
+#: parsed (fn, shared_args, kwargs) tuples by content digest; tiny FIFO —
+#: a worker group rarely runs more than init_hook + the job, and a fat
+#: entry (model factories, tokenizer tables) must not accumulate.
+_BLOB_CACHE_CAP = 4
+
+
 def main(argv) -> int:
     host, port, rank, world = argv[1], int(argv[2]), int(argv[3]), int(argv[4])
     authkey = bytes.fromhex(os.environ.pop("RLT_WORKER_AUTHKEY"))
@@ -91,6 +121,7 @@ def main(argv) -> int:
     channel = _WorkerChannel(conn, rank, world)
     channel.send(("hello", rank, {"pid": os.getpid(), "ip": _node_ip()}))
     session_bound = False
+    blob_cache: dict = {}  # digest -> (fn, shared_args, kwargs)
     while True:
         msg = conn.recv()
         cmd = msg[0]
@@ -104,6 +135,30 @@ def main(argv) -> int:
                     _bind_session(channel)
                     session_bound = True
                 result = fn(*args, **kwargs)
+                channel.send(("result", tid, cloudpickle.dumps(result)))
+            except BaseException:
+                channel.send(("error", tid, traceback.format_exc()))
+        elif cmd == "exec2":
+            tid, digest, blob, extra_blob = msg[1], msg[2], msg[3], msg[4]
+            if blob is None and digest not in blob_cache:
+                # The driver's cache mirror was optimistic (an eviction
+                # it replayed differently, or an earlier blob whose parse
+                # failed): ask for a resend instead of failing the task —
+                # cache desyncs self-heal.
+                channel.send(("need_blob", tid, digest))
+                continue
+            try:
+                if blob is not None and digest not in blob_cache:
+                    parsed = cloudpickle.loads(blob)  # before any insert
+                    while len(blob_cache) >= _BLOB_CACHE_CAP:
+                        blob_cache.pop(next(iter(blob_cache)))
+                    blob_cache[digest] = parsed
+                fn, args, kwargs = blob_cache[digest]
+                extra = cloudpickle.loads(extra_blob)
+                if not session_bound:
+                    _bind_session(channel)
+                    session_bound = True
+                result = fn(*args, *extra, **kwargs)
                 channel.send(("result", tid, cloudpickle.dumps(result)))
             except BaseException:
                 channel.send(("error", tid, traceback.format_exc()))

@@ -135,15 +135,34 @@ class _ReportServer:
                  bind_all: bool = False):
         self._handle = handle_report
         self._authkey = secrets.token_bytes(32)
-        # remote trials must reach the channel: bind all interfaces and
-        # advertise the routable address (cf. WorkerGroup's listener)
-        self._listener = Listener(
-            ("0.0.0.0" if bind_all else "127.0.0.1", 0),
-            authkey=self._authkey,
-        )
+        # Remote trials must reach the channel: bind the cluster-facing
+        # interface and advertise its address (cf. WorkerGroup.start —
+        # binding the SPECIFIC interface, not 0.0.0.0, keeps the
+        # authenticated-but-cleartext pickle channel off networks no
+        # trial dials in on; trusted-network assumption documented in
+        # runtime/transport.py SECURITY note).
         from ray_lightning_tpu.runtime.group import routable_ip
 
         self._advertise = routable_ip() if bind_all else "127.0.0.1"
+        if bind_all and self._advertise == "127.0.0.1":
+            raise RuntimeError(
+                "cannot determine a routable address for host-placed "
+                "trials (no default route). Set RLT_NODE_IP to this "
+                "machine's cluster-facing IP."
+            )
+        try:
+            self._listener = Listener((self._advertise, 0),
+                                      authkey=self._authkey)
+        except OSError:
+            # advertise may be a NAT/forwarded address that is valid to
+            # dial but not a local interface (cf. WorkerGroup.start's
+            # identical fallback)
+            log.warning(
+                "report-channel advertise address %s is not a local "
+                "interface; binding 0.0.0.0 (ensure the network path to "
+                "trials is trusted)", self._advertise,
+            )
+            self._listener = Listener(("0.0.0.0", 0), authkey=self._authkey)
         self._closed = False
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True
