@@ -1,0 +1,160 @@
+"""The 8B dress rehearsal — no hardware required (VERDICT r3 #4).
+
+BASELINE.json config 4 is "Llama-3-8B, FSDP-on-XLA across v5p-64". These
+tests make that north star checkable on a CPU box:
+
+  1. the EXACT param/opt/grad footprint of the full 8B TrainState under
+     the proposed ShardedMesh, via eval_shape + the strategy's own
+     sharding composition over an AbstractMesh (parallel/plan.py) —
+     asserted to fit v5p HBM with the activation bound included;
+  2. the planner must also be able to say NO (the same model on a
+     too-small topology does not fit — a planner that always passes
+     proves nothing);
+  3. the true-8B-config train step (remat + scan + fused CE at
+     dim 4096 / 32 layers / V=128256) AOT-lowers over a REAL 8-device
+     virtual mesh with its real FSDP shardings — the sharded program
+     builds, not just its shapes.
+"""
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.models.llama import LlamaConfig, LlamaModule
+from ray_lightning_tpu.parallel.plan import (
+    HBM_BYTES_BY_KIND,
+    llama_activation_bytes,
+    plan_train_memory,
+)
+from ray_lightning_tpu.parallel.strategy import ShardedMesh
+
+GIB = 1024**3
+
+
+def _cfg_8b(**kw):
+    # the flagship path: remat+scan (the only class that holds at 8B),
+    # fused CE (materialized [B,S,V] logits provably OOM at V=128256)
+    return LlamaConfig.llama3_8b(
+        remat=True, scan_layers=True, fused_ce=True, **kw
+    )
+
+
+def _batch_struct(batch, seq):
+    return {"tokens": np.zeros((batch, seq + 1), np.int32)}
+
+
+def test_8b_fits_v5p_64_under_fsdp():
+    """The north-star plan: Llama-3-8B, FSDP over 64 v5p chips,
+    global batch 64 x S=8192."""
+    cfg = _cfg_8b(max_seq_len=8192)
+    n_dev, global_batch, seq = 64, 64, 8192
+    acts = llama_activation_bytes(cfg, local_batch=global_batch // n_dev,
+                                  seq=seq)
+    plan = plan_train_memory(
+        LlamaModule(cfg),
+        ShardedMesh(fsdp=n_dev),
+        n_devices=n_dev,
+        example_batch=_batch_struct(global_batch, seq),
+        activation_bytes_per_device=acts,
+        device_kind="TPU v5p",
+    )
+    # the plan's param accounting IS Llama-3-8B: ~8.03B f32 params
+    n_params = plan.params_bytes_global / 4
+    assert 7.9e9 < n_params < 8.1e9, f"{n_params:.3e} params"
+    # adamw: mu + nu, param-shaped -> ~2x params (+ tiny schedule scalars)
+    assert plan.opt_bytes_global == pytest.approx(
+        2 * plan.params_bytes_global, rel=0.01)
+    # FSDP actually sharded the big state ~evenly over 64 devices
+    assert plan.params_bytes_per_device < plan.params_bytes_global / 48
+    assert plan.fits, plan.summary()
+
+
+def test_8b_plan_rejects_undersized_topology():
+    """Same model, 8 v5e chips (16 GiB): params+opt alone are ~12 GiB per
+    device before activations — the planner must refuse."""
+    cfg = _cfg_8b(max_seq_len=8192)
+    plan = plan_train_memory(
+        LlamaModule(cfg),
+        ShardedMesh(fsdp=8),
+        n_devices=8,
+        example_batch=_batch_struct(8, 8192),
+        activation_bytes_per_device=llama_activation_bytes(cfg, 1, 8192),
+        device_kind="TPU v5e",
+    )
+    assert not plan.fits, plan.summary()
+    assert plan.hbm_bytes_per_device == HBM_BYTES_BY_KIND["TPU v5e"]
+
+
+def test_plan_respects_tensor_axis_specs():
+    """Megatron tensor specs from the module overlay the fsdp auto-spec:
+    a tensor=8 mesh splits the qkv projection's output dim 8-ways."""
+    cfg = _cfg_8b(max_seq_len=2048)
+    plan_t = plan_train_memory(
+        LlamaModule(cfg), ShardedMesh(tensor=8), n_devices=8,
+        example_batch=_batch_struct(8, 2048), device_kind="TPU v5p",
+    )
+    plan_r = plan_train_memory(
+        LlamaModule(cfg), ShardedMesh(data=8), n_devices=8,
+        example_batch=_batch_struct(8, 2048), device_kind="TPU v5p",
+    )
+    # pure DP replicates everything; TP cuts per-device param bytes hard
+    assert plan_r.params_bytes_per_device == plan_r.params_bytes_global
+    assert plan_t.params_bytes_per_device < 0.2 * plan_t.params_bytes_global
+
+
+@pytest.mark.slow
+def test_8b_program_lowers_on_virtual_mesh(devices8):
+    """AOT-lower the REAL 8B training step (value_and_grad + adamw update,
+    donated state — the bench/Trainer step shape) over an 8-device mesh
+    with its real FSDP shardings. Lowering traces the full scanned+remat
+    model and partitions types against the shardings; it is the cheap
+    proof that the 8B sharded program BUILDS (compile-to-executable of a
+    95-GiB-footprint program is neither possible nor needed on a CPU
+    box)."""
+    import jax
+    import optax
+    from functools import partial
+
+    cfg = _cfg_8b(max_seq_len=8192)
+    module = LlamaModule(cfg)
+    strategy = ShardedMesh(fsdp=8, devices=devices8)
+    strategy.setup(module)
+    module.setup()  # the Trainer's fit() ordering: mesh first, then model
+
+    batch, seq = 8, 8192
+    tokens_sds = jax.ShapeDtypeStruct((batch, seq + 1), np.int32)
+    a_params = jax.eval_shape(
+        module.init_params, jax.random.key(0),
+        {"tokens": tokens_sds},
+    )
+    p_shardings = strategy.param_shardings(a_params)
+    tx = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
+    a_opt = jax.eval_shape(tx.init, a_params)
+    o_shardings = strategy.opt_state_shardings(a_opt, a_params)
+
+    def loss_fn(params, tokens):
+        return module._loss(params, tokens[:, :-1], tokens[:, 1:], None)
+
+    @partial(jax.jit, donate_argnums=(0, 1),
+             in_shardings=(p_shardings, o_shardings,
+                           strategy.batch_sharding()),
+             out_shardings=(p_shardings, o_shardings, None))
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    lowered = step.lower(
+        jax.tree.map(lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype,
+                                                       sharding=s),
+                     a_params, p_shardings),
+        jax.tree.map(lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype,
+                                                       sharding=s),
+                     a_opt, o_shardings),
+        jax.ShapeDtypeStruct((batch, seq + 1), np.int32,
+                             sharding=strategy.batch_sharding()),
+    )
+    hlo = lowered.as_text()
+    assert "sharding" in hlo  # the program carries real shardings
+    # loss out is a replicated f32 scalar — shapes flowed end to end
+    out_avals = jax.tree.leaves(lowered.out_info)
+    assert any(getattr(o, "shape", None) == () for o in out_avals)
