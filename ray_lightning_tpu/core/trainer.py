@@ -61,6 +61,7 @@ class Trainer:
         callbacks: Optional[List[Callback]] = None,
         limit_train_batches: Optional[int] = None,
         limit_val_batches: Optional[int] = None,
+        limit_test_batches: Optional[int] = None,
         check_val_every_n_epoch: int = 1,
         val_check_interval: Optional[int] = None,
         log_every_n_steps: int = 50,
@@ -79,6 +80,7 @@ class Trainer:
         self.max_steps = max_steps
         self.limit_train_batches = limit_train_batches
         self.limit_val_batches = limit_val_batches
+        self.limit_test_batches = limit_test_batches
         self.check_val_every_n_epoch = max(1, check_val_every_n_epoch)
         #: mid-epoch validation every N optimizer steps (long-epoch /
         #: streaming LLM runs where epoch boundaries are meaningless)
@@ -344,7 +346,7 @@ class Trainer:
             dataloaders = datamodule.test_dataloader()
         self._eval_step = self._make_eval_step(module, module.test_step)
         self._ensure_state(module, dataloaders)
-        metrics = self._run_eval_epoch(dataloaders, limit=self.limit_val_batches)
+        metrics = self._run_eval_epoch(dataloaders, limit=self.limit_test_batches)
         self.callback_metrics.update(metrics)
         return metrics
 
