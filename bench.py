@@ -36,7 +36,8 @@ _DEFAULT_PEAK = 197.0  # assume v5e-class when unknown (CPU runs, new kinds)
 
 
 def _bench_cfg(use_flash: bool, fused_ce: bool, seq: int,
-               vocab: int = 32768, remat: bool = True, scan: bool = True):
+               vocab: int = 32768, remat: bool = True, scan: bool = True,
+               remat_policy: str = "nothing", ce_chunk_tokens: int = 2048):
     from ray_lightning_tpu.models.llama import LlamaConfig
 
     return LlamaConfig(
@@ -49,8 +50,9 @@ def _bench_cfg(use_flash: bool, fused_ce: bool, seq: int,
         max_seq_len=seq,
         use_flash=use_flash,
         fused_ce=fused_ce,
-        ce_chunk_tokens=2048,
+        ce_chunk_tokens=ce_chunk_tokens,
         remat=remat,
+        remat_policy=remat_policy,
         scan_layers=scan,
     )
 
@@ -71,13 +73,15 @@ def _flops_per_token(cfg, seq: int) -> float:
 
 
 def _make_step(use_flash: bool, fused_ce: bool, batch: int, seq: int,
-               vocab: int = 32768, remat: bool = True, scan: bool = True):
+               vocab: int = 32768, remat: bool = True, scan: bool = True,
+               remat_policy: str = "nothing", ce_chunk_tokens: int = 2048):
     import jax
     import optax
 
     from ray_lightning_tpu.models.llama import Llama, LlamaModule
 
-    cfg = _bench_cfg(use_flash, fused_ce, seq, vocab, remat, scan)
+    cfg = _bench_cfg(use_flash, fused_ce, seq, vocab, remat, scan,
+                     remat_policy, ce_chunk_tokens)
     model = Llama(cfg)
     module = LlamaModule(cfg)
     module.model = model
@@ -126,33 +130,52 @@ def _time_step(step, params, opt_state, tokens, warmup=3, iters=5,
 
 
 def _measure(use_flash: bool, fused_ce: bool, batch: int, seq: int,
-             vocab: int = 32768, remat: bool = True, scan: bool = True):
+             vocab: int = 32768, remat: bool = True, scan: bool = True,
+             remat_policy: str = "nothing", ce_chunk_tokens: int = 2048):
     step, params, opt_state, tokens, tps, cfg = _make_step(
-        use_flash, fused_ce, batch, seq, vocab, remat, scan
+        use_flash, fused_ce, batch, seq, vocab, remat, scan,
+        remat_policy, ce_chunk_tokens
     )
     dt = _time_step(step, params, opt_state, tokens)
     del step, params, opt_state, tokens
     return tps / dt, cfg
 
 
-def _probe_matmul_tflops(iters: int = 20) -> float:
-    """Bare 4096^3 bf16 matmul throughput — a model-free health probe.
+def _probe_matmul_tflops(loop_iters: int = 64, windows: int = 3,
+                         n: int = 8192) -> float:
+    """Bare n^3 bf16 matmul throughput — a model-free health probe.
     Far below the spec-sheet peak (e.g. <100 on a 197-TFLOP/s v5e) means
     the chip is externally contended; the model numbers in the same JSON
-    line should then be read as lower bounds, not capability."""
+    line should then be read as lower bounds, not capability.
+
+    The chain of dependent matmuls runs inside ONE jitted `fori_loop`
+    (~70 TFLOP per dispatch), so per-dispatch latency — which through a
+    remote-device tunnel dwarfs a single small matmul and made the old
+    per-call probe measure dispatch instead of throughput (34.5 "TFLOP/s"
+    on a chip simultaneously delivering 117 to the model step) — is
+    amortized to noise; measured saturation on v5e: 64 iters reads within
+    1% of 128. `b` holds 1/n in every entry so the iterate stays exactly
+    1: no overflow, nothing for XLA to fold (both operands are runtime
+    inputs). Best-of-windows for the same reason as `_time_step`."""
     import jax
     import jax.numpy as jnp
 
-    x = jnp.ones((4096, 4096), jnp.bfloat16)
-    f = jax.jit(lambda a: a @ a)
-    r = f(x)
-    float(jax.device_get(r[0, 0]))
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        r = f(r)
-    float(jax.device_get(r[0, 0]))
-    dt = (time.perf_counter() - t0) / iters
-    return 2 * 4096**3 / dt / 1e12
+    b = jnp.full((n, n), 1.0 / n, jnp.bfloat16)
+
+    @jax.jit
+    def chain(a, b):
+        return jax.lax.fori_loop(
+            0, loop_iters, lambda _, acc: acc @ b, a, unroll=4
+        )
+
+    a = jnp.ones((n, n), jnp.bfloat16)
+    float(jax.device_get(chain(a, b)[0, 0]))  # compile + warm
+    best = float("inf")
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        float(jax.device_get(chain(a, b)[0, 0]))
+        best = min(best, time.perf_counter() - t0)
+    return 2 * n**3 * loop_iters / best / 1e12
 
 
 def main() -> None:
@@ -161,7 +184,13 @@ def main() -> None:
     device = jax.devices()[0]
     kind = device.device_kind
     peak_tflops = _PEAK_TFLOPS.get(kind, _DEFAULT_PEAK)
-    probe = _probe_matmul_tflops()
+    # full-size probe only on known accelerators: ~280 TFLOP of matmul is
+    # seconds on a TPU but would stall a CPU smoke run for many minutes —
+    # unknown kinds get a tiny probe that still reports a number
+    if kind in _PEAK_TFLOPS:
+        probe = _probe_matmul_tflops()
+    else:
+        probe = _probe_matmul_tflops(loop_iters=4, windows=1, n=1024)
 
     # Tuned configs per leg, from the v5e sweeps (batch 2..16; chunk
     # 1k..24k; remat on/off x nothing/dots; scan on/off):
@@ -197,6 +226,30 @@ def main() -> None:
     v128k_mfu = (v128k_tps * _flops_per_token(v128k_cfg, 2048)
                  / (peak_tflops * 1e12))
 
+    # FLAGSHIP leg: remat + scan_layers + fused CE at the Llama-3 vocab —
+    # the only configuration class that holds at the north-star
+    # Llama-3-8B (BASELINE.md config 4: remat+scan+FSDP are mandatory at
+    # 8B on real chips), benched first-class at its swept optimum
+    # (scripts/sweep_flagship.py: remat_policy x batch x ce_chunk x flash
+    # blocks under remat). MFU counts useful FLOPs only — the backward
+    # recompute remat performs is real work the flagship deliberately
+    # trades for memory, so its MFU reads lower than the unrolled legs.
+    flag_tps, flag_cfg = _measure(
+        use_flash=True, fused_ce=True, batch=8, seq=2048, vocab=128256,
+        remat=True, scan=True, remat_policy="nothing",
+        ce_chunk_tokens=4096,
+    )
+    flag_mfu = (flag_tps * _flops_per_token(flag_cfg, 2048)
+                / (peak_tflops * 1e12))
+
+    # Self-consistency (VERDICT r3 weak #1): the probe is a THROUGHPUT
+    # ceiling; any model leg reading more effective FLOP/s than the bare
+    # matmul chain means one of the two mismeasured. Flag it in-line
+    # rather than shipping arithmetic that cannot all be true.
+    best_model_tflops = max(
+        mfu, s4k_mfu, v128k_mfu, flag_mfu) * peak_tflops
+    probe_consistent = probe >= 0.95 * best_model_tflops
+
     print(
         json.dumps(
             {
@@ -209,11 +262,16 @@ def main() -> None:
                 "device_kind": kind,
                 "flops_per_token": round(fpt / 1e9, 3),  # GFLOP
                 "probe_matmul_tflops": round(probe, 1),
+                "probe_consistent": probe_consistent,
                 "s4096_tokens_per_sec": round(s4k_tps, 1),
                 "s4096_mfu": round(s4k_mfu, 4),
                 "v128k_tokens_per_sec": round(v128k_tps, 1),
                 "v128k_mfu": round(v128k_mfu, 4),
                 "v128k_materialized_logits": "OOM (does not compile)",
+                "flagship_tokens_per_sec": round(flag_tps, 1),
+                "flagship_mfu": round(flag_mfu, 4),
+                "flagship_config": "remat(nothing)+scan+fusedCE "
+                                   "B=8 S=2048 V=128256 chunk=4096",
             }
         )
     )

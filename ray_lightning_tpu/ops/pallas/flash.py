@@ -23,6 +23,7 @@ XLA path otherwise.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -371,10 +372,18 @@ def flash_attention_pallas(
     causal: bool = True,
     q_offset: int = 0,
     scale: float | None = None,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
+    block_q: int | None = None,
+    block_k: int | None = None,
 ) -> jnp.ndarray:
-    """Flash attention on [B, S, H, D] tensors (framework layout)."""
+    """Flash attention on [B, S, H, D] tensors (framework layout).
+
+    ``block_q``/``block_k`` default to the tuned module constants,
+    overridable per-process via ``RLT_FLASH_BLOCK_Q``/``RLT_FLASH_BLOCK_K``
+    (read at trace time — the sweep harness's tuning knob)."""
+    if block_q is None:
+        block_q = int(os.environ.get("RLT_FLASH_BLOCK_Q", DEFAULT_BLOCK_Q))
+    if block_k is None:
+        block_k = int(os.environ.get("RLT_FLASH_BLOCK_K", DEFAULT_BLOCK_K))
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     qt = q.transpose(0, 2, 1, 3)  # [B, H, S, D]
     kt = k.transpose(0, 2, 1, 3)

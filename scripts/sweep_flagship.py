@@ -1,0 +1,146 @@
+"""Sweep the flagship training path (remat=True + scan_layers=True +
+fused CE at the Llama-3 vocabulary) on the real chip.
+
+VERDICT r3 #1: this is the only configuration class that can hold at the
+north-star Llama-3-8B (BASELINE.md config 4), and it had never been swept
+on its own — remat shifts the optimum (recompute competes with the flash
+kernel for VMEM; freed activation memory admits larger batches).
+
+Dimensions: remat_policy (nothing|dots) x batch, then ce_chunk_tokens,
+then flash block sizes (via RLT_FLASH_BLOCK_Q/K) at the incumbent best.
+Appends one JSON line per config to scripts/sweep_flagship_results.jsonl
+so a partial sweep is still a usable record.
+
+Usage: python scripts/sweep_flagship.py [phase]   # phase in {1,2,3,all}
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RESULTS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "sweep_flagship_results.jsonl")
+
+
+def run_one(tag: str, *, batch: int, policy: str, chunk: int,
+            block_q: int | None = None, block_k: int | None = None,
+            vocab: int = 128256, seq: int = 2048):
+    import bench
+
+    for key, val in (("RLT_FLASH_BLOCK_Q", block_q),
+                     ("RLT_FLASH_BLOCK_K", block_k)):
+        if val is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = str(val)
+    rec = {"tag": tag, "batch": batch, "policy": policy, "chunk": chunk,
+           "block_q": block_q, "block_k": block_k, "vocab": vocab,
+           "seq": seq}
+    t0 = time.time()
+    try:
+        step, params, opt_state, tokens, tps_tokens, cfg = bench._make_step(
+            use_flash=True, fused_ce=True, batch=batch, seq=seq,
+            vocab=vocab, remat=True, scan=True,
+        )
+        # patch policy/chunk via a fresh cfg-bearing step
+        if policy != "nothing" or chunk != 2048:
+            del step, params, opt_state, tokens
+            step, params, opt_state, tokens, tps_tokens, cfg = _make_step2(
+                batch, seq, vocab, policy, chunk)
+        dt = bench._time_step(step, params, opt_state, tokens)
+        tps = tps_tokens / dt
+        import jax
+        peak = bench._PEAK_TFLOPS.get(jax.devices()[0].device_kind,
+                                      bench._DEFAULT_PEAK)
+        mfu = tps * bench._flops_per_token(cfg, seq) / (peak * 1e12)
+        rec.update(tokens_per_sec=round(tps, 1), mfu=round(mfu, 4),
+                   step_ms=round(dt * 1e3, 2))
+        del step, params, opt_state, tokens
+    except Exception as exc:  # noqa: BLE001 — OOM/compile failures are data
+        rec.update(error=f"{type(exc).__name__}: {str(exc)[:300]}")
+    rec["wall_s"] = round(time.time() - t0, 1)
+    with open(RESULTS, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def _make_step2(batch, seq, vocab, policy, chunk):
+    """bench._make_step with remat_policy/ce_chunk_tokens overrides."""
+    import dataclasses
+    from functools import partial
+
+    import jax
+    import numpy as np
+    import optax
+
+    import bench
+    from ray_lightning_tpu.models.llama import Llama, LlamaModule
+
+    cfg = bench._bench_cfg(True, True, seq, vocab, True, True)
+    cfg = dataclasses.replace(cfg, remat_policy=policy,
+                              ce_chunk_tokens=chunk)
+    model = Llama(cfg)
+    module = LlamaModule(cfg)
+    module.model = model
+    tokens = jax.random.randint(
+        jax.random.key(0), (batch, seq + 1), 0, cfg.vocab_size,
+        dtype=np.int32)
+    params = jax.jit(model.init)(jax.random.key(0), tokens[:, :-1])["params"]
+    tx = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
+    opt_state = jax.jit(tx.init)(params)
+
+    def loss_fn(params, tokens):
+        return module._loss(params, tokens[:, :-1], tokens[:, 1:], None)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step, params, opt_state, tokens, batch * seq, cfg
+
+
+def best_so_far():
+    best = None
+    try:
+        with open(RESULTS) as f:
+            for line in f:
+                rec = json.loads(line)
+                if "tokens_per_sec" in rec and (
+                        best is None
+                        or rec["tokens_per_sec"] > best["tokens_per_sec"]):
+                    best = rec
+    except FileNotFoundError:
+        pass
+    return best
+
+
+def main():
+    phase = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if phase in ("1", "all"):
+        for policy in ("nothing", "dots"):
+            for batch in (4, 8, 16):
+                run_one(f"p1-{policy}-b{batch}", batch=batch, policy=policy,
+                        chunk=2048)
+    if phase in ("2", "all"):
+        b = best_so_far()
+        for chunk in (1024, 4096, 8192):
+            run_one(f"p2-chunk{chunk}", batch=b["batch"], policy=b["policy"],
+                    chunk=chunk)
+    if phase in ("3", "all"):
+        b = best_so_far()
+        for bq, bk in ((256, 1024), (512, 512), (1024, 1024), (512, 2048)):
+            run_one(f"p3-q{bq}k{bk}", batch=b["batch"], policy=b["policy"],
+                    chunk=b["chunk"], block_q=bq, block_k=bk)
+    print("BEST:", json.dumps(best_so_far()), flush=True)
+
+
+if __name__ == "__main__":
+    main()

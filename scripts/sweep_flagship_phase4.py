@@ -1,0 +1,18 @@
+"""Focused follow-up to sweep_flagship.py: batch fill-in around the
+incumbent (b8 / nothing / chunk4096 / default flash blocks) plus the one
+untried block shape. Appends to the same results file."""
+import sys
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts.sweep_flagship import run_one, best_so_far  # noqa: E402
+import json  # noqa: E402
+
+if __name__ == "__main__":
+    run_one("p4-b10", batch=10, policy="nothing", chunk=4096)
+    run_one("p4-b12", batch=12, policy="nothing", chunk=4096)
+    run_one("p4-q512k2048", batch=8, policy="nothing", chunk=4096,
+            block_q=512, block_k=2048)
+    run_one("p4-chunk6144", batch=8, policy="nothing", chunk=6144)
+    print("BEST:", json.dumps(best_so_far()), flush=True)
