@@ -58,6 +58,15 @@ def gpipe_apply(
 
     Returns ``x`` after all L layers (same shape/sharding as input).
     With pipe size 1 this degrades to a plain layer scan.
+
+    Composition caveat: "composes with data/fsdp" means the BATCH axis —
+    activations stay dp/fsdp-sharded. Parameters do NOT: each stage's
+    in_spec shards only the layer axis on `pipe` and replicates every
+    other param dim, so combining pipe>1 with fsdp>1 all-gathers each
+    stage's full layer block inside the shard_map for the duration of
+    the step (GPipe owns whole layers by design). For memory-bound
+    models prefer fsdp WITHOUT pipe, or accept per-stage unsharded
+    weights as the pipeline's cost.
     """
     pipe = mesh.shape.get(axis_name, 1)
     body = (jax.checkpoint(stage_fn, policy=remat_policy) if remat
