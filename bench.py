@@ -21,18 +21,12 @@ from functools import partial
 
 import numpy as np
 
-# bf16 peak TFLOP/s per chip, by PJRT device_kind (public spec sheets)
-_PEAK_TFLOPS = {
-    "TPU v3": 123.0,
-    "TPU v4": 275.0,
-    "TPU v5 lite": 197.0,  # v5e
-    "TPU v5e": 197.0,
-    "TPU v5": 459.0,       # v5p
-    "TPU v5p": 459.0,
-    "TPU v6 lite": 918.0,  # v6e / Trillium
-    "TPU v6e": 918.0,
-}
-_DEFAULT_PEAK = 197.0  # assume v5e-class when unknown (CPU runs, new kinds)
+# peak table + probe shared with the doctor CLI (utils/probe.py)
+from ray_lightning_tpu.utils.probe import (  # noqa: E402
+    DEFAULT_PEAK as _DEFAULT_PEAK,
+    PEAK_TFLOPS as _PEAK_TFLOPS,
+    matmul_tflops as _probe_matmul_tflops,
+)
 
 
 def _bench_cfg(use_flash: bool, fused_ce: bool, seq: int,
@@ -141,56 +135,18 @@ def _measure(use_flash: bool, fused_ce: bool, batch: int, seq: int,
     return tps / dt, cfg
 
 
-def _probe_matmul_tflops(loop_iters: int = 64, windows: int = 3,
-                         n: int = 8192) -> float:
-    """Bare n^3 bf16 matmul throughput — a model-free health probe.
-    Far below the spec-sheet peak (e.g. <100 on a 197-TFLOP/s v5e) means
-    the chip is externally contended; the model numbers in the same JSON
-    line should then be read as lower bounds, not capability.
-
-    The chain of dependent matmuls runs inside ONE jitted `fori_loop`
-    (~70 TFLOP per dispatch), so per-dispatch latency — which through a
-    remote-device tunnel dwarfs a single small matmul and made the old
-    per-call probe measure dispatch instead of throughput (34.5 "TFLOP/s"
-    on a chip simultaneously delivering 117 to the model step) — is
-    amortized to noise; measured saturation on v5e: 64 iters reads within
-    1% of 128. `b` holds 1/n in every entry so the iterate stays exactly
-    1: no overflow, nothing for XLA to fold (both operands are runtime
-    inputs). Best-of-windows for the same reason as `_time_step`."""
-    import jax
-    import jax.numpy as jnp
-
-    b = jnp.full((n, n), 1.0 / n, jnp.bfloat16)
-
-    @jax.jit
-    def chain(a, b):
-        return jax.lax.fori_loop(
-            0, loop_iters, lambda _, acc: acc @ b, a, unroll=4
-        )
-
-    a = jnp.ones((n, n), jnp.bfloat16)
-    float(jax.device_get(chain(a, b)[0, 0]))  # compile + warm
-    best = float("inf")
-    for _ in range(windows):
-        t0 = time.perf_counter()
-        float(jax.device_get(chain(a, b)[0, 0]))
-        best = min(best, time.perf_counter() - t0)
-    return 2 * n**3 * loop_iters / best / 1e12
-
-
 def main() -> None:
     import jax
 
     device = jax.devices()[0]
     kind = device.device_kind
     peak_tflops = _PEAK_TFLOPS.get(kind, _DEFAULT_PEAK)
-    # full-size probe only on known accelerators: ~280 TFLOP of matmul is
-    # seconds on a TPU but would stall a CPU smoke run for many minutes —
-    # unknown kinds get a tiny probe that still reports a number
-    if kind in _PEAK_TFLOPS:
-        probe = _probe_matmul_tflops()
-    else:
-        probe = _probe_matmul_tflops(loop_iters=4, windows=1, n=1024)
+    # device-aware sizing inside the probe: full ~280-TFLOP chain on
+    # known accelerators (seconds on a TPU; amortizes tunnel dispatch
+    # latency — the old per-call probe read 34.5 "TFLOP/s" on a chip
+    # simultaneously delivering 117 to the model step), tiny on unknown
+    # kinds so CPU smoke runs don't stall for minutes
+    probe = _probe_matmul_tflops()
 
     # Tuned configs per leg, from the v5e sweeps (batch 2..16; chunk
     # 1k..24k; remat on/off x nothing/dots; scan on/off):

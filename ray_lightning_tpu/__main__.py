@@ -1,14 +1,24 @@
-"""``python -m ray_lightning_tpu`` — environment/topology doctor.
+"""``python -m ray_lightning_tpu`` — environment/topology doctor + planner.
 
 Pod-debugging UX the reference delegated to Ray's dashboard: one command
 answers "what does THIS process see" — backend, process/device topology
 (the rank helpers of SURVEY §5.8), per-device kind/slice, and optionally
 a bare-matmul throughput probe that makes external contention on shared
-chips visible (same probe bench.py embeds in its JSON).
+chips visible (the same throughput-bound probe bench.py embeds in its
+JSON, utils/probe.py).
 
     python -m ray_lightning_tpu            # topology, no device touch
     python -m ray_lightning_tpu --probe    # + matmul TFLOP/s
     python -m ray_lightning_tpu --json     # machine-readable
+
+``plan`` runs the pre-flight memory planner (parallel/plan.py) with no
+devices touched at all — size a model against a proposed mesh and chip
+before queueing for hardware:
+
+    python -m ray_lightning_tpu plan --preset llama3-8b \\
+        --fsdp 64 --batch 64 --seq 8192 --device-kind "TPU v5p"
+
+Exit status: 0 when the plan fits, 1 when it does not.
 """
 from __future__ import annotations
 
@@ -41,21 +51,69 @@ def collect(probe: bool = False) -> dict:
     if len(devices) > 16:
         info["devices_truncated"] = len(devices) - 16
     if probe:
-        import time
+        from ray_lightning_tpu.utils.probe import (
+            device_peak_tflops,
+            matmul_tflops,
+        )
 
-        import jax.numpy as jnp
+        from ray_lightning_tpu.utils.probe import PEAK_TFLOPS
 
-        x = jnp.ones((4096, 4096), jnp.bfloat16)
-        f = jax.jit(lambda a: a @ a)
-        r = f(x)
-        float(jax.device_get(r[0, 0]))
-        t0 = time.perf_counter()
-        for _ in range(10):
-            r = f(r)
-        float(jax.device_get(r[0, 0]))
-        dt = (time.perf_counter() - t0) / 10
-        info["probe_matmul_tflops"] = round(2 * 4096**3 / dt / 1e12, 1)
+        info["probe_matmul_tflops"] = round(matmul_tflops(), 1)
+        info["peak_tflops"] = device_peak_tflops(devices[0].device_kind)
+        # unknown kinds get the v5e-class fallback — label it honestly
+        info["peak_is_assumed"] = devices[0].device_kind not in PEAK_TFLOPS
     return info
+
+
+def run_plan(args) -> int:
+    import numpy as np
+
+    from ray_lightning_tpu.models.llama import LlamaConfig, LlamaModule
+    from ray_lightning_tpu.parallel.plan import (
+        llama_activation_bytes,
+        plan_train_memory,
+    )
+    from ray_lightning_tpu.parallel.strategy import ShardedMesh
+
+    presets = {
+        "llama3-8b": LlamaConfig.llama3_8b,
+        "tiny": LlamaConfig.tiny,
+    }
+    cfg = presets[args.preset](
+        remat=True, scan_layers=True, fused_ce=True, max_seq_len=args.seq
+    )
+    n_devices = args.data * args.fsdp * args.tensor
+    dp = max(1, args.data) * max(1, args.fsdp)
+    if args.batch % dp != 0:
+        # a clamped/floored local batch would produce a FITS verdict for
+        # a job that cannot actually shard its batch — refuse up front
+        print(f"error: global batch {args.batch} is not divisible by the "
+              f"data-parallel degree {dp} (data x fsdp); the job could "
+              "not shard this batch. Pick batch = k x "
+              f"{dp}.")
+        return 2
+    plan = plan_train_memory(
+        LlamaModule(cfg),
+        ShardedMesh(data=args.data, fsdp=args.fsdp, tensor=args.tensor),
+        n_devices=n_devices,
+        example_batch={"tokens": np.zeros((args.batch, args.seq + 1),
+                                          np.int32)},
+        activation_bytes_per_device=llama_activation_bytes(
+            cfg, args.batch // dp, args.seq),
+        device_kind=args.device_kind,
+    )
+    if args.as_json:
+        print(json.dumps({
+            "mesh": plan.mesh_axes,
+            "n_devices": plan.n_devices,
+            "per_device_bytes": plan.per_device_total,
+            "budget_bytes": plan.budget,
+            "fits": plan.fits,
+            "summary": plan.summary(),
+        }))
+    else:
+        print(plan.summary())
+    return 0 if plan.fits else 1
 
 
 def main(argv=None) -> int:
@@ -64,7 +122,25 @@ def main(argv=None) -> int:
                    help="run a bare-matmul throughput probe (touches and "
                         "may briefly occupy the accelerator)")
     p.add_argument("--json", action="store_true", dest="as_json")
+    sub = p.add_subparsers(dest="cmd")
+    plan_p = sub.add_parser(
+        "plan", help="pre-flight memory plan for a model x mesh x chip "
+                     "(no devices touched)")
+    plan_p.add_argument("--preset", choices=("llama3-8b", "tiny"),
+                        default="llama3-8b")
+    plan_p.add_argument("--data", type=int, default=1)
+    plan_p.add_argument("--fsdp", type=int, default=64)
+    plan_p.add_argument("--tensor", type=int, default=1)
+    plan_p.add_argument("--batch", type=int, default=64,
+                        help="global batch (rows)")
+    plan_p.add_argument("--seq", type=int, default=8192)
+    plan_p.add_argument("--device-kind", default="TPU v5p",
+                        choices=("TPU v3", "TPU v4", "TPU v5e", "TPU v5p",
+                                 "TPU v6e"))
+    plan_p.add_argument("--json", action="store_true", dest="as_json")
     args = p.parse_args(argv)
+    if args.cmd == "plan":
+        return run_plan(args)
     info = collect(probe=args.probe)
     if args.as_json:
         print(json.dumps(info))
@@ -80,7 +156,9 @@ def main(argv=None) -> int:
     if info.get("devices_truncated"):
         print(f"  ... and {info['devices_truncated']} more")
     if "probe_matmul_tflops" in info:
-        print(f"probe: {info['probe_matmul_tflops']} TFLOP/s bf16 matmul")
+        label = "assumed peak" if info["peak_is_assumed"] else "spec peak"
+        print(f"probe: {info['probe_matmul_tflops']} TFLOP/s bf16 matmul "
+              f"({label} {info['peak_tflops']})")
     return 0
 
 

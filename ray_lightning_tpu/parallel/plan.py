@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import os
 from typing import Any, Dict, Optional
 
 import jax
@@ -161,13 +162,30 @@ def plan_train_memory(
     strategy.bind_module(module)
     module.setup()
 
-    a_params = jax.eval_shape(
-        module.init_params, jax.random.key(0), _abstract(example_batch)
-    )
-    p_shardings = strategy.param_shardings(a_params)
-    tx = module.configure_optimizers()
-    a_opt = jax.eval_shape(tx.init, a_params)
-    o_shardings = strategy.opt_state_shardings(a_opt, a_params)
+    # The planner must never initialize a jax backend — it may be run
+    # precisely because the accelerator is unavailable. Two traps:
+    #   * a concrete jax.random.key(0) would materialize on the default
+    #     device → the rng key is eval_shape'd abstract instead;
+    #   * the pallas dispatch decision (ops/dispatch.py on_tpu) queries
+    #     jax.default_backend() at TRACE time → pin it off via the
+    #     documented RLT_PALLAS env knob, which is consulted before any
+    #     backend probe (kernel choice cannot change shapes).
+    a_key = jax.eval_shape(lambda: jax.random.key(0))
+    prev_pallas = os.environ.get("RLT_PALLAS")
+    os.environ["RLT_PALLAS"] = "0"
+    try:
+        a_params = jax.eval_shape(
+            module.init_params, a_key, _abstract(example_batch)
+        )
+        p_shardings = strategy.param_shardings(a_params)
+        tx = module.configure_optimizers()
+        a_opt = jax.eval_shape(tx.init, a_params)
+        o_shardings = strategy.opt_state_shardings(a_opt, a_params)
+    finally:
+        if prev_pallas is None:
+            os.environ.pop("RLT_PALLAS", None)
+        else:
+            os.environ["RLT_PALLAS"] = prev_pallas
 
     params_dev = _sharded_tree_bytes(a_params, p_shardings)
     opt_dev = _sharded_tree_bytes(a_opt, o_shardings)

@@ -38,3 +38,28 @@ def test_doctor_main_human_output(capsys):
     out = capsys.readouterr().out
     assert "ray_lightning_tpu" in out
     assert "devices" in out
+
+
+def test_doctor_plan_subcommand(capsys):
+    """`plan` sizes a model against a mesh/chip with no devices touched;
+    exit status encodes fits (0) vs does-not-fit (1)."""
+    from ray_lightning_tpu.__main__ import main
+
+    rc = main(["plan", "--preset", "llama3-8b", "--fsdp", "64",
+               "--batch", "64", "--seq", "8192",
+               "--device-kind", "TPU v5p", "--json"])
+    info = json.loads(capsys.readouterr().out.strip())
+    assert rc == 0 and info["fits"] is True
+    assert info["mesh"] == {"fsdp": 64}
+
+    rc = main(["plan", "--preset", "llama3-8b", "--fsdp", "8",
+               "--batch", "8", "--seq", "8192",
+               "--device-kind", "TPU v5e"])
+    out = capsys.readouterr().out
+    assert rc == 1 and "DOES NOT FIT" in out
+
+    # unshardable batch: refused (exit 2), never a bogus FITS
+    rc = main(["plan", "--preset", "llama3-8b", "--data", "4",
+               "--fsdp", "64", "--batch", "64"])
+    out = capsys.readouterr().out
+    assert rc == 2 and "not divisible" in out

@@ -100,6 +100,42 @@ def test_plan_respects_tensor_axis_specs():
     assert plan_t.params_bytes_per_device < 0.2 * plan_t.params_bytes_global
 
 
+def test_planner_initializes_no_backend():
+    """The planner's contract: NO jax backend is ever initialized — it
+    must work on a box whose accelerator is unreachable (the exact
+    situation where you need a pre-flight plan). Regression for two
+    traps: a concrete PRNG key, and the pallas dispatch probing
+    jax.default_backend() at trace time."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = (
+        "import numpy as np\n"
+        "from ray_lightning_tpu.models.llama import LlamaConfig, LlamaModule\n"
+        "from ray_lightning_tpu.parallel.plan import plan_train_memory\n"
+        "from ray_lightning_tpu.parallel.strategy import ShardedMesh\n"
+        "cfg = LlamaConfig.tiny(remat=True, fused_ce=True)\n"
+        "plan = plan_train_memory(LlamaModule(cfg), ShardedMesh(fsdp=8),\n"
+        "    n_devices=8,\n"
+        "    example_batch={'tokens': np.zeros((8, 257), np.int32)})\n"
+        "assert plan.fits\n"
+        "from jax._src import xla_bridge\n"
+        "assert not xla_bridge.backends_are_initialized(), \\\n"
+        "    'planning initialized a backend'\n"
+        "print('NO-BACKEND-OK')\n"
+    )
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=180, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "NO-BACKEND-OK" in out.stdout
+
+
 @pytest.mark.slow
 def test_8b_program_lowers_on_virtual_mesh(devices8):
     """AOT-lower the REAL 8B training step (value_and_grad + adamw update,
