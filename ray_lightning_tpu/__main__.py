@@ -18,7 +18,10 @@ before queueing for hardware:
     python -m ray_lightning_tpu plan --preset llama3-8b \\
         --fsdp 64 --batch 64 --seq 8192 --device-kind "TPU v5p"
 
-Exit status: 0 when the plan fits, 1 when it does not.
+Exit status: 0 when the plan fits, 1 when it does not, 2 when the
+configuration is invalid (e.g. a global batch not divisible by the
+data-parallel degree — refused rather than planned wrong; the error goes
+to stderr, or an {"error": ...} object with --json).
 """
 from __future__ import annotations
 
@@ -52,11 +55,10 @@ def collect(probe: bool = False) -> dict:
         info["devices_truncated"] = len(devices) - 16
     if probe:
         from ray_lightning_tpu.utils.probe import (
+            PEAK_TFLOPS,
             device_peak_tflops,
             matmul_tflops,
         )
-
-        from ray_lightning_tpu.utils.probe import PEAK_TFLOPS
 
         info["probe_matmul_tflops"] = round(matmul_tflops(), 1)
         info["peak_tflops"] = device_peak_tflops(devices[0].device_kind)
@@ -87,10 +89,15 @@ def run_plan(args) -> int:
     if args.batch % dp != 0:
         # a clamped/floored local batch would produce a FITS verdict for
         # a job that cannot actually shard its batch — refuse up front
-        print(f"error: global batch {args.batch} is not divisible by the "
-              f"data-parallel degree {dp} (data x fsdp); the job could "
-              "not shard this batch. Pick batch = k x "
-              f"{dp}.")
+        import sys
+
+        msg = (f"global batch {args.batch} is not divisible by the "
+               f"data-parallel degree {dp} (data x fsdp); the job could "
+               f"not shard this batch. Pick batch = k x {dp}.")
+        if args.as_json:
+            print(json.dumps({"error": msg}))
+        else:
+            print(f"error: {msg}", file=sys.stderr)
         return 2
     plan = plan_train_memory(
         LlamaModule(cfg),

@@ -5,9 +5,34 @@ future backend (or a forced-interpret env knob) changes here only.
 """
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import os
 
 import jax
+
+#: context-scoped dispatch override (see `force_xla`): unlike the env
+#: knob this never leaks across threads/tasks in the same process.
+_forced: contextvars.ContextVar[bool | None] = contextvars.ContextVar(
+    "rlt_pallas_forced", default=None
+)
+
+
+@contextlib.contextmanager
+def force_xla():
+    """Pin dispatch to the XLA reference path for the current context.
+
+    For trace-only consumers (the pre-flight planner): the pallas
+    decision path queries `jax.default_backend()`, which would
+    INITIALIZE a backend — and kernel choice cannot change shapes, so an
+    abstract trace loses nothing by skipping it. A contextvar, not an
+    env write: concurrent traces in other threads keep their kernels.
+    """
+    token = _forced.set(False)
+    try:
+        yield
+    finally:
+        _forced.reset(token)
 
 
 def on_tpu() -> bool:
@@ -25,9 +50,13 @@ def interpret_mode() -> bool:
 
 
 def use_pallas(override: bool | None = None) -> bool:
-    """Dispatch decision: explicit argument > RLT_PALLAS env > backend."""
+    """Dispatch decision: explicit argument > force_xla context >
+    RLT_PALLAS env > backend."""
     if override is not None:
         return override
+    forced = _forced.get()
+    if forced is not None:
+        return forced
     env = os.environ.get("RLT_PALLAS")
     if env is not None:
         return env == "1"

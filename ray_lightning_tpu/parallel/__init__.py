@@ -1,4 +1,9 @@
 from ray_lightning_tpu.parallel.mesh import MeshSpec, make_mesh, AXIS_ORDER
+from ray_lightning_tpu.parallel.plan import (
+    MemoryPlan,
+    llama_activation_bytes,
+    plan_train_memory,
+)
 from ray_lightning_tpu.parallel.strategy import (
     Strategy,
     DataParallel,
@@ -12,6 +17,9 @@ __all__ = [
     "MeshSpec",
     "make_mesh",
     "AXIS_ORDER",
+    "MemoryPlan",
+    "llama_activation_bytes",
+    "plan_train_memory",
     "Strategy",
     "DataParallel",
     "FSDP",

@@ -31,7 +31,6 @@ from __future__ import annotations
 
 import dataclasses
 import math
-import os
 from typing import Any, Dict, Optional
 
 import jax
@@ -167,13 +166,14 @@ def plan_train_memory(
     #   * a concrete jax.random.key(0) would materialize on the default
     #     device → the rng key is eval_shape'd abstract instead;
     #   * the pallas dispatch decision (ops/dispatch.py on_tpu) queries
-    #     jax.default_backend() at TRACE time → pin it off via the
-    #     documented RLT_PALLAS env knob, which is consulted before any
-    #     backend probe (kernel choice cannot change shapes).
+    #     jax.default_backend() at TRACE time → pin the XLA reference
+    #     path via the context-scoped override (kernel choice cannot
+    #     change shapes; a contextvar, unlike an env write, leaves
+    #     concurrent traces in other threads untouched).
+    from ray_lightning_tpu.ops.dispatch import force_xla
+
     a_key = jax.eval_shape(lambda: jax.random.key(0))
-    prev_pallas = os.environ.get("RLT_PALLAS")
-    os.environ["RLT_PALLAS"] = "0"
-    try:
+    with force_xla():
         a_params = jax.eval_shape(
             module.init_params, a_key, _abstract(example_batch)
         )
@@ -181,11 +181,6 @@ def plan_train_memory(
         tx = module.configure_optimizers()
         a_opt = jax.eval_shape(tx.init, a_params)
         o_shardings = strategy.opt_state_shardings(a_opt, a_params)
-    finally:
-        if prev_pallas is None:
-            os.environ.pop("RLT_PALLAS", None)
-        else:
-            os.environ["RLT_PALLAS"] = prev_pallas
 
     params_dev = _sharded_tree_bytes(a_params, p_shardings)
     opt_dev = _sharded_tree_bytes(a_opt, o_shardings)

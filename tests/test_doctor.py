@@ -58,8 +58,13 @@ def test_doctor_plan_subcommand(capsys):
     out = capsys.readouterr().out
     assert rc == 1 and "DOES NOT FIT" in out
 
-    # unshardable batch: refused (exit 2), never a bogus FITS
+    # unshardable batch: refused (exit 2, error on stderr / a JSON error
+    # object with --json), never a bogus FITS
     rc = main(["plan", "--preset", "llama3-8b", "--data", "4",
                "--fsdp", "64", "--batch", "64"])
-    out = capsys.readouterr().out
-    assert rc == 2 and "not divisible" in out
+    captured = capsys.readouterr()
+    assert rc == 2 and "not divisible" in captured.err
+    rc = main(["plan", "--preset", "llama3-8b", "--data", "4",
+               "--fsdp", "64", "--batch", "64", "--json"])
+    info = json.loads(capsys.readouterr().out.strip())
+    assert rc == 2 and "not divisible" in info["error"]

@@ -195,6 +195,9 @@ class TestLlamaTraining:
 
 
 class TestGraftEntry:
+    @pytest.mark.slow  # the already-initialized-backend branch of the
+    # dryrun; the self-provisioning branch stays in the fast suite
+    # (tests/test_graft_entry.py), which keeps the driver contract covered
     def test_dryrun_multichip(self, devices8):
         import importlib.util, os
 

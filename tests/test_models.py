@@ -6,6 +6,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from ray_lightning_tpu import DataLoader, DataParallel, FSDP, Trainer
 from ray_lightning_tpu.models import (
@@ -34,6 +35,7 @@ def synthetic_text(n=64, num_classes=2, seq=16, vocab=256, seed=0):
     return {"input_ids": ids, "attention_mask": mask, "labels": y}
 
 
+@pytest.mark.slow  # ResNet fwd+bwd compile dominates (~3 min on 1 core)
 def test_resnet18_trains_dp(devices8, tmp_path):
     data = synthetic_cifar()
     module = ResNetModule(variant="resnet18", num_classes=4, lr=0.05,
