@@ -35,6 +35,14 @@ def force_xla():
         _forced.reset(token)
 
 
+def forced_choice() -> bool | None:
+    """The force_xla() context override, or None outside it — for ops
+    (norms) whose DEFAULT differs from the backend-based policy but must
+    still honor the context pin (it exists so trace-only consumers never
+    touch a backend)."""
+    return _forced.get()
+
+
 def on_tpu() -> bool:
     """True when the default backend is a real TPU."""
     try:

@@ -20,7 +20,14 @@ def rms_norm(
 ) -> jnp.ndarray:
     """y = x / rms(x) * weight, reducing over the last axis in f32."""
     if use_pallas is None:
-        use_pallas = os.environ.get("RLT_PALLAS", "0") == "1"
+        from ray_lightning_tpu.ops.dispatch import forced_choice
+
+        # honor force_xla() (trace-only contexts must not reach the
+        # kernel path, whose interpret_mode probe touches the backend);
+        # otherwise this op defaults OFF unless RLT_PALLAS=1
+        forced = forced_choice()
+        use_pallas = (forced if forced is not None
+                      else os.environ.get("RLT_PALLAS", "0") == "1")
     if use_pallas:
         from ray_lightning_tpu.ops.pallas.rmsnorm import rms_norm_pallas
 

@@ -105,6 +105,21 @@ def _trial_main(trainable, config, trial_id, trial_dir, address, authkey_hex,
     """Body of one trial — runs inside the trial's own worker process
     (the analog of the reference's trial-actor trainable,
     reference examples/ray_ddp_example.py:61-76)."""
+    # The process env is the platform contract (the SPMD path asserts it
+    # in _spmd_main; trials must too): site hooks that register a custom
+    # jax backend can config.update jax_platforms at interpreter start,
+    # OVERRIDING the JAX_PLATFORMS this trial was launched with — a
+    # CPU-pinned trial would then silently initialize (and run on!) the
+    # site's accelerator backend. Re-assert before any jax touch; if a
+    # backend is somehow already live, leave it (update would raise).
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", platforms)
+        except Exception:  # noqa: BLE001 — initialized backends win
+            pass
     ctx = trial_session.RemoteTrialContext(
         trial_id, trial_dir, address, bytes.fromhex(authkey_hex),
         last_checkpoint=resume_from,

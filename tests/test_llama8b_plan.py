@@ -129,6 +129,10 @@ def test_planner_initializes_no_backend():
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     env.pop("JAX_PLATFORMS", None)
+    # the adversarial setting: RLT_PALLAS=1 pushes every op toward the
+    # kernel path, whose interpret-mode probe queries the backend —
+    # force_xla() must pin ALL of them off during the plan trace
+    env["RLT_PALLAS"] = "1"
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
     out = subprocess.run([sys.executable, "-c", code], capture_output=True,
                          text=True, timeout=180, env=env)
