@@ -136,6 +136,32 @@ def _measure(use_flash: bool, fused_ce: bool, batch: int, seq: int,
 
 
 def main() -> None:
+    import os
+    import threading
+
+    # Watchdog: a wedged device tunnel (observed on shared-chip setups:
+    # every op, even jax.devices(), blocks forever) must surface as an
+    # honest JSON error line for the bench recorder, not a silent hang.
+    # <= 0 disables.
+    watchdog_s = float(os.environ.get("RLT_BENCH_WATCHDOG_S", "2700"))
+    finished = threading.Event()
+
+    def _watchdog():
+        if not finished.wait(watchdog_s):
+            print(json.dumps({
+                "metric": "llama_0.5b_train_tokens_per_sec_per_chip",
+                "value": 0.0,
+                "unit": "tokens/sec",
+                "vs_baseline": 0.0,
+                "error": (f"benchmark did not complete within "
+                          f"{watchdog_s:.0f}s — device unreachable or "
+                          "compile hang; rerun when the chip is healthy"),
+            }), flush=True)
+            os._exit(3)
+
+    if watchdog_s > 0:
+        threading.Thread(target=_watchdog, daemon=True).start()
+
     import jax
 
     device = jax.devices()[0]
@@ -231,6 +257,7 @@ def main() -> None:
             }
         )
     )
+    finished.set()
 
 
 if __name__ == "__main__":

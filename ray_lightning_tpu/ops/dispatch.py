@@ -35,14 +35,6 @@ def force_xla():
         _forced.reset(token)
 
 
-def forced_choice() -> bool | None:
-    """The force_xla() context override, or None outside it — for ops
-    (norms) whose DEFAULT differs from the backend-based policy but must
-    still honor the context pin (it exists so trace-only consumers never
-    touch a backend)."""
-    return _forced.get()
-
-
 def on_tpu() -> bool:
     """True when the default backend is a real TPU."""
     try:
@@ -57,9 +49,12 @@ def interpret_mode() -> bool:
     return not on_tpu()
 
 
-def use_pallas(override: bool | None = None) -> bool:
+def use_pallas(override: bool | None = None,
+               default: bool | None = None) -> bool:
     """Dispatch decision: explicit argument > force_xla context >
-    RLT_PALLAS env > backend."""
+    RLT_PALLAS env > ``default`` (ops whose policy is not
+    backend-derived, e.g. rms_norm's off-by-default — also skips the
+    backend probe entirely) > backend."""
     if override is not None:
         return override
     forced = _forced.get()
@@ -68,4 +63,6 @@ def use_pallas(override: bool | None = None) -> bool:
     env = os.environ.get("RLT_PALLAS")
     if env is not None:
         return env == "1"
+    if default is not None:
+        return default
     return on_tpu()

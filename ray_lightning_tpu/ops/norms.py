@@ -7,8 +7,6 @@ bf16 activations — matches Llama reference numerics.
 """
 from __future__ import annotations
 
-import os
-
 import jax.numpy as jnp
 
 
@@ -20,14 +18,13 @@ def rms_norm(
 ) -> jnp.ndarray:
     """y = x / rms(x) * weight, reducing over the last axis in f32."""
     if use_pallas is None:
-        from ray_lightning_tpu.ops.dispatch import forced_choice
+        from ray_lightning_tpu.ops import dispatch
 
-        # honor force_xla() (trace-only contexts must not reach the
-        # kernel path, whose interpret_mode probe touches the backend);
-        # otherwise this op defaults OFF unless RLT_PALLAS=1
-        forced = forced_choice()
-        use_pallas = (forced if forced is not None
-                      else os.environ.get("RLT_PALLAS", "0") == "1")
+        # one dispatch policy for all ops (dispatch.py) — this op's only
+        # deviation is its default: OFF unless RLT_PALLAS=1 (default=False
+        # also skips the backend probe, which trace-only force_xla()
+        # contexts must never reach)
+        use_pallas = dispatch.use_pallas(default=False)
     if use_pallas:
         from ray_lightning_tpu.ops.pallas.rmsnorm import rms_norm_pallas
 
