@@ -143,7 +143,12 @@ def main() -> None:
     # every op, even jax.devices(), blocks forever) must surface as an
     # honest JSON error line for the bench recorder, not a silent hang.
     # <= 0 disables.
-    watchdog_s = float(os.environ.get("RLT_BENCH_WATCHDOG_S", "2700"))
+    try:
+        watchdog_s = float(os.environ.get("RLT_BENCH_WATCHDOG_S", "2700"))
+    except ValueError:
+        # a malformed value must not reproduce the silent-failure mode
+        # the watchdog exists to prevent
+        watchdog_s = 2700.0
     finished = threading.Event()
 
     def _watchdog():

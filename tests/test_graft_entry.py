@@ -42,9 +42,19 @@ def test_dryrun_multichip_self_provisions():
 
 @pytest.mark.slow
 def test_entry_compiles():
+    # The config re-assert mirrors the framework's platform contract
+    # (tuner._trial_main / launch._spmd_main): site hooks that register
+    # an accelerator backend may config.update jax_platforms at
+    # interpreter start, overriding the env — this test must compile on
+    # CPU, not on whatever device the box tunnels to.
     proc = subprocess.run(
         [sys.executable, "-c",
-         "import jax, __graft_entry__ as g;"
+         "import jax\n"
+         "try:\n"
+         "    jax.config.update('jax_platforms', 'cpu')\n"
+         "except Exception:\n"
+         "    pass  # initialized backends win (cf. tuner._trial_main)\n"
+         "import __graft_entry__ as g;"
          "fn, args = g.entry();"
          "out = jax.jit(fn)(*args);"
          "jax.block_until_ready(out); print('OK', out.shape)"],
