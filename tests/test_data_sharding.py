@@ -13,7 +13,11 @@ batches.
 import numpy as np
 import pytest
 
-from ray_lightning_tpu.core.data import DataLoader, ensure_sharded
+from ray_lightning_tpu.core.data import (
+    DataLoader,
+    DataModule,
+    ensure_sharded,
+)
 
 from tests.utils import IdSumModel
 
@@ -152,6 +156,45 @@ def test_distributed_fit_auto_shards_unsharded_loaders(tmp_path):
         sum(range(112, 128)) + sum(range(240, 256)))
     # val leg (forced per-stage, like the reference's val sampler):
     assert result.metrics["val_dup_rows"] == 0.0
+
+
+class _IdsDataModule(DataModule):
+    """DataModule with deliberately UNSHARDED loaders — the launcher must
+    resolve per-stage loaders and inject shard semantics into each."""
+
+    def setup(self):
+        n = 256
+        self._x = np.arange(n, dtype=np.float32)[:, None] * np.ones(
+            (1, 4), np.float32)
+        self._y = (np.arange(n) % 2).astype(np.int32)
+
+    def train_dataloader(self):
+        return DataLoader({"x": self._x, "y": self._y}, batch_size=16)
+
+    def val_dataloader(self):
+        return DataLoader({"x": self._x, "y": self._y}, batch_size=16)
+
+
+@pytest.mark.slow
+def test_distributed_fit_auto_shards_datamodule(tmp_path):
+    """The DataModule path through _job_remote: per-stage loaders are
+    resolved worker-side and each gets forced shard semantics."""
+    from ray_lightning_tpu.runtime import fit_distributed
+
+    result = fit_distributed(
+        _make_module,
+        _make_trainer,
+        _IdsDataModule,
+        num_processes=2,
+        platform="cpu",
+        num_cpu_devices_per_process=2,
+        log_dir=str(tmp_path),
+        timeout=420,
+    )
+    assert result.metrics["dup_rows"] == 0.0
+    assert result.metrics["val_dup_rows"] == 0.0
+    assert result.metrics["id_sum"] == float(
+        sum(range(112, 128)) + sum(range(240, 256)))
 
 
 def _make_plain_iterable_data():
