@@ -53,8 +53,8 @@ def routable_ip() -> str:
     ``get_node_ip``, ray_ddp.py:33-35). ``RLT_NODE_IP`` overrides — the
     multi-NIC escape hatch: the UDP-connect trick picks the
     default-route interface, which on a multi-homed cluster host may not
-    be the fabric the other hosts dial (set RLT_NODE_IP per host, e.g.
-    via the transport env, to pin the data-network address). No packet
+    be the fabric the other hosts dial (set RLT_NODE_IP per host via the
+    transport's host_env to pin the data-network address). No packet
     is sent; falls back to loopback on isolated boxes — callers on a
     remote path must treat that fallback as an error (see
     WorkerGroup.start), not an address."""
@@ -298,6 +298,19 @@ class WorkerGroup:
     # ------------------------------------------------------------- launch
     def start(self) -> "WorkerGroup":
         os.makedirs(self.log_dir, exist_ok=True)
+        host_env = getattr(self.transport, "host_env", None)
+        if host_env:
+            # a typo'd host_env key silently dropping RLT_NODE_IP would
+            # reproduce the exact multi-NIC hang the override exists to
+            # fix — surface the mismatch (warning, not error: a shared
+            # transport may carry entries for other groups' hosts)
+            unmatched = set(host_env) - set(self.hosts or [])
+            if unmatched:
+                log.warning(
+                    "transport host_env keys match no launched host "
+                    "(typo? keys must equal the hosts= entries): %s",
+                    sorted(unmatched),
+                )
         authkey = secrets.token_bytes(32)
         # Remote workers must reach the driver: bind the cluster-facing
         # interface and advertise its address (the reference's Listener

@@ -147,6 +147,13 @@ class SSHTransport(Transport):
         installed into the interpreter).
     env: transport-level env applied to every worker, merged under the
         group's per-launch env.
+    host_env: per-host env overrides keyed by the host string passed to
+        WorkerGroup(hosts=...) — applied on top of ``env`` for workers
+        on that host. The multi-NIC escape hatch: on multi-homed hosts,
+        ``host_env={ssh_addr: {"RLT_NODE_IP": fabric_addr}}`` pins the
+        address the worker advertises (and, for worker 0, the jax
+        coordinator binds) to the data network, independent of the
+        address ssh dials.
 
     v5p-pod recipe (one worker per host VM)::
 
@@ -162,11 +169,13 @@ class SSHTransport(Transport):
         remote_python: str = "python3",
         pythonpath: Sequence[str] = (),
         env: Optional[Dict[str, str]] = None,
+        host_env: Optional[Dict[str, Dict[str, str]]] = None,
     ):
         self.ssh = list(ssh)
         self.remote_python = remote_python
         self.pythonpath = list(pythonpath)
         self.env = dict(env or {})
+        self.host_env = {k: dict(v) for k, v in (host_env or {}).items()}
 
     def _command(self, host: Optional[str]) -> list:
         if not host:
@@ -179,7 +188,9 @@ class SSHTransport(Transport):
 
     def spawn(self, *, host, connect, env, authkey_hex, log_path):
         source = _bootstrap_source(
-            connect, {**self.env, **env}, authkey_hex, self.pythonpath
+            connect,
+            {**self.env, **env, **self.host_env.get(host or "", {})},
+            authkey_hex, self.pythonpath,
         )
         logf = open(log_path, "w")
         try:

@@ -171,6 +171,49 @@ def test_remote_transport_two_hosts(tmp_path):
     assert transport.spawned == [("host-a", 0), ("host-b", 1)]
 
 
+def test_per_host_env_overrides(tmp_path):
+    """SSH-family transports apply host_env on top of the launch env —
+    the multi-NIC escape hatch: RLT_NODE_IP pinned per host changes the
+    address that host's worker advertises in its hello (which worker 0
+    feeds to the jax coordinator resolution)."""
+    transport = LoopbackTransport(host_env={
+        "host-b": {"RLT_TEST_HOSTENV": "b-only",
+                   "RLT_NODE_IP": "10.99.0.2"},
+    })
+    with WorkerGroup(
+        hosts=["host-a", "host-b"],
+        transport=transport,
+        env={"RLT_TEST_HOSTENV": "default", "JAX_PLATFORMS": "cpu"},
+        log_dir=str(tmp_path),
+    ) as g:
+        assert g.run(_read_env, per_rank_args=[("RLT_TEST_HOSTENV",)] * 2) \
+            == ["default", "b-only"]
+        assert g.executors[1].get_node_ip() == "10.99.0.2"
+        assert g.executors[0].get_node_ip() != "10.99.0.2"
+
+
+def test_unmatched_host_env_key_warns(tmp_path, monkeypatch):
+    """A typo'd host_env key must be surfaced — silently dropping an
+    RLT_NODE_IP override reproduces the multi-NIC hang it exists to fix.
+    (Asserted on the logger call: the package logger owns its handler
+    and does not propagate to root, so caplog cannot see it.)"""
+    from ray_lightning_tpu.runtime import group as group_mod
+
+    warnings = []
+    monkeypatch.setattr(
+        group_mod.log, "warning",
+        lambda msg, *args, **kw: warnings.append(msg % args if args else msg),
+    )
+    transport = LoopbackTransport(host_env={
+        "user@host-b": {"RLT_NODE_IP": "10.99.0.2"},  # hosts= says "host-b"
+    })
+    with WorkerGroup(hosts=["host-a", "host-b"], transport=transport,
+                     env={"JAX_PLATFORMS": "cpu"},
+                     log_dir=str(tmp_path)) as g:
+        g.run(_pid)
+    assert any("user@host-b" in w for w in warnings)
+
+
 def test_ssh_transport_command_and_bootstrap():
     """SSHTransport mechanics without an ssh binary: the argv it would
     exec, and the self-contained bootstrap program piped over stdin."""
