@@ -71,7 +71,9 @@ def run_plan(args) -> int:
     import numpy as np
 
     from ray_lightning_tpu.models.llama import LlamaConfig, LlamaModule
+    from ray_lightning_tpu.parallel.mesh import MeshSpec
     from ray_lightning_tpu.parallel.plan import (
+        dp_degree,
         llama_activation_bytes,
         plan_train_memory,
     )
@@ -85,7 +87,8 @@ def run_plan(args) -> int:
         remat=True, scan_layers=True, fused_ce=True, max_seq_len=args.seq
     )
     n_devices = args.data * args.fsdp * args.tensor
-    dp = max(1, args.data) * max(1, args.fsdp)
+    dp = dp_degree(MeshSpec(data=args.data, fsdp=args.fsdp,
+                            tensor=args.tensor))
     if args.batch % dp != 0:
         # a clamped/floored local batch would produce a FITS verdict for
         # a job that cannot actually shard its batch — refuse up front
