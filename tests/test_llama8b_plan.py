@@ -141,14 +141,15 @@ def test_planner_initializes_no_backend():
 
 
 @pytest.mark.slow
-def test_8b_program_lowers_on_virtual_mesh(devices8):
-    """AOT-lower the REAL 8B training step (value_and_grad + adamw update,
-    donated state — the bench/Trainer step shape) over an 8-device mesh
-    with its real FSDP shardings. Lowering traces the full scanned+remat
-    model and partitions types against the shardings; it is the cheap
-    proof that the 8B sharded program BUILDS (compile-to-executable of a
-    95-GiB-footprint program is neither possible nor needed on a CPU
-    box)."""
+def test_8b_program_compiles_on_virtual_mesh(devices8):
+    """AOT-compile the REAL 8B training step (value_and_grad + adamw
+    update, donated state — the bench/Trainer step shape) over an
+    8-device mesh with its real FSDP shardings: tracing, StableHLO
+    lowering, the XLA SPMD partitioner AND buffer assignment all run
+    (compiling plans buffers, it does not allocate them — ~12s on one
+    CPU core), and the executable's own memory_analysis must agree with
+    the planner's per-device param+opt arithmetic. This is the strongest
+    no-hardware proof that the north-star program BUILDS."""
     import jax
     import optax
     from functools import partial
@@ -166,7 +167,9 @@ def test_8b_program_lowers_on_virtual_mesh(devices8):
         {"tokens": tokens_sds},
     )
     p_shardings = strategy.param_shardings(a_params)
-    tx = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
+    # the module's REAL optimizer — the same transformation the planner
+    # measures, so the byte cross-check below compares like with like
+    tx = module.configure_optimizers()
     a_opt = jax.eval_shape(tx.init, a_params)
     o_shardings = strategy.opt_state_shardings(a_opt, a_params)
 
@@ -198,3 +201,24 @@ def test_8b_program_lowers_on_virtual_mesh(devices8):
     # loss out is a replicated f32 scalar — shapes flowed end to end
     out_avals = jax.tree.leaves(lowered.out_info)
     assert any(getattr(o, "shape", None) == () for o in out_avals)
+
+    compiled = lowered.compile()
+    mem = compiled.memory_analysis()
+    # XLA's buffer assignment must agree with the planner's arithmetic:
+    # per-device arguments = sharded params (f32) + adamw mu/nu + the
+    # token batch — ~12.05 GB at fsdp=8. (Planner cross-check at the
+    # byte level; 2% slack for layout padding/bookkeeping buffers. A
+    # fresh module+strategy per plan_train_memory's contract.)
+    plan = plan_train_memory(
+        LlamaModule(cfg), ShardedMesh(fsdp=8), n_devices=8,
+        example_batch={"tokens": np.zeros((batch, seq + 1), np.int32)},
+        device_kind="TPU v5p",
+    )
+    expected_args = (plan.params_bytes_per_device
+                    + plan.opt_bytes_per_device)
+    assert abs(mem.argument_size_in_bytes - expected_args) \
+        < 0.02 * expected_args + 2**20, (
+        mem.argument_size_in_bytes, expected_args)
+    # donation wired through: outputs alias the donated state
+    assert mem.alias_size_in_bytes > 0.9 * expected_args
+    assert mem.temp_size_in_bytes > 0  # activations/workspace planned
