@@ -11,9 +11,12 @@ tests make that north star checkable on a CPU box:
      too-small topology does not fit — a planner that always passes
      proves nothing);
   3. the true-8B-config train step (remat + scan + fused CE at
-     dim 4096 / 32 layers / V=128256) AOT-lowers over a REAL 8-device
-     virtual mesh with its real FSDP shardings — the sharded program
-     builds, not just its shapes.
+     dim 4096 / 32 layers / V=128256) AOT-COMPILES over a REAL 8-device
+     virtual mesh — pure FSDP and the Megatron-TP x FSDP composition —
+     running the SPMD partitioner and buffer assignment, with XLA's own
+     memory_analysis asserted against the planner's byte arithmetic;
+  4. the planner never initializes a jax backend (it must work on a box
+     whose accelerator is unreachable).
 """
 import numpy as np
 import pytest
@@ -141,14 +144,16 @@ def test_planner_initializes_no_backend():
 
 
 @pytest.mark.slow
-def test_8b_program_compiles_on_virtual_mesh(devices8):
+@pytest.mark.parametrize("fsdp,tensor", [(8, 1), (4, 2)])
+def test_8b_program_compiles_on_virtual_mesh(devices8, fsdp, tensor):
     """AOT-compile the REAL 8B training step (value_and_grad + adamw
     update, donated state — the bench/Trainer step shape) over an
-    8-device mesh with its real FSDP shardings: tracing, StableHLO
-    lowering, the XLA SPMD partitioner AND buffer assignment all run
-    (compiling plans buffers, it does not allocate them — ~12s on one
-    CPU core), and the executable's own memory_analysis must agree with
-    the planner's per-device param+opt arithmetic. This is the strongest
+    8-device mesh with its real shardings — pure FSDP, and the
+    Megatron-TP x FSDP composition: tracing, StableHLO lowering, the XLA
+    SPMD partitioner AND buffer assignment all run (compiling plans
+    buffers, it does not allocate them — ~12s per config on one CPU
+    core), and the executable's own memory_analysis must agree with the
+    planner's per-device param+opt arithmetic. This is the strongest
     no-hardware proof that the north-star program BUILDS."""
     import jax
     import optax
@@ -156,7 +161,7 @@ def test_8b_program_compiles_on_virtual_mesh(devices8):
 
     cfg = _cfg_8b(max_seq_len=8192)
     module = LlamaModule(cfg)
-    strategy = ShardedMesh(fsdp=8, devices=devices8)
+    strategy = ShardedMesh(fsdp=fsdp, tensor=tensor, devices=devices8)
     strategy.setup(module)
     module.setup()  # the Trainer's fit() ordering: mesh first, then model
 
@@ -205,12 +210,13 @@ def test_8b_program_compiles_on_virtual_mesh(devices8):
     compiled = lowered.compile()
     mem = compiled.memory_analysis()
     # XLA's buffer assignment must agree with the planner's arithmetic:
-    # per-device arguments = sharded params (f32) + adamw mu/nu + the
-    # token batch — ~12.05 GB at fsdp=8. (Planner cross-check at the
-    # byte level; 2% slack for layout padding/bookkeeping buffers. A
-    # fresh module+strategy per plan_train_memory's contract.)
+    # per-device arguments = sharded params (f32) + adamw mu/nu
+    # (~12.05 GB at fsdp=8; the ~32 KiB/device token buffer and any
+    # layout padding live inside the 2%+1MiB slack). A fresh
+    # module+strategy per plan_train_memory's contract.
     plan = plan_train_memory(
-        LlamaModule(cfg), ShardedMesh(fsdp=8), n_devices=8,
+        LlamaModule(cfg), ShardedMesh(fsdp=fsdp, tensor=tensor),
+        n_devices=8,
         example_batch={"tokens": np.zeros((batch, seq + 1), np.int32)},
         device_kind="TPU v5p",
     )
