@@ -107,6 +107,13 @@ class TpuModule:
 
     def apply(self, params, *args, rngs=None, **kwargs):
         """Call the inner flax module: `self.apply(params, x)`."""
+        if self.model is None:
+            raise RuntimeError(
+                f"{type(self).__name__}.model is not built. If setup() "
+                "has not run yet, call it (Trainer.fit / "
+                "load_from_checkpoint do); if it has, configure_model() "
+                "returned None — implement it (or override apply())."
+            )
         return self.model.apply({"params": params}, *args, rngs=rngs, **kwargs)
 
     def log(self, name: str, value) -> None:
