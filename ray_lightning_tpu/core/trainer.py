@@ -333,7 +333,7 @@ class Trainer:
             datamodule.setup()
             dataloaders = datamodule.val_dataloader()
         self._eval_step = self._make_eval_step(module, module.validation_step)
-        self._ensure_state(module, dataloaders)
+        dataloaders = self._ensure_state(module, dataloaders)
         metrics = self._run_eval_epoch(dataloaders, limit=self.limit_val_batches)
         self.callback_metrics.update(metrics)
         return metrics
@@ -345,7 +345,7 @@ class Trainer:
             datamodule.setup()
             dataloaders = datamodule.test_dataloader()
         self._eval_step = self._make_eval_step(module, module.test_step)
-        self._ensure_state(module, dataloaders)
+        dataloaders = self._ensure_state(module, dataloaders)
         metrics = self._run_eval_epoch(dataloaders, limit=self.limit_test_batches)
         self.callback_metrics.update(metrics)
         return metrics
@@ -356,7 +356,7 @@ class Trainer:
         if datamodule is not None:
             datamodule.setup()
             dataloaders = datamodule.predict_dataloader()
-        self._ensure_state(module, dataloaders)
+        dataloaders = self._ensure_state(module, dataloaders)
         step = jax.jit(lambda p, b: module.predict_step(p, b))
         outs = []
         for batch in dataloaders:
@@ -407,9 +407,13 @@ class Trainer:
         module.setup()
         return module
 
-    def _ensure_state(self, module: TpuModule, loader) -> None:
+    def _ensure_state(self, module: TpuModule, loader):
+        """Build eval-only state; returns the loader to ITERATE — when
+        init peeked batch 0 off a one-shot iterator, the returned loader
+        is the re-stitched chain that still contains it (callers must
+        rebind, or the first batch silently disappears from eval)."""
         if self.state is not None:
-            return
+            return loader
         if module.params is None:
             if loader is None:
                 raise ValueError("module has no params and no data to init from")
@@ -424,6 +428,7 @@ class Trainer:
         self.state = TrainState(step=step0, params=params, opt_state=())
         if self._eval_step is None:
             self._eval_step = self._make_eval_step(module, module.validation_step)
+        return loader
 
     def _build_tx(self, module: TpuModule) -> optax.GradientTransformation:
         tx = module.configure_optimizers()
@@ -590,7 +595,19 @@ class Trainer:
         import itertools
 
         it = iter(loader)
-        first = next(it)
+        try:
+            first = next(it)
+        except StopIteration:
+            # an empty loader would otherwise surface as a raw
+            # StopIteration; the usual cause is drop_last truncation —
+            # a per-process shard smaller than one batch
+            raise ValueError(
+                "the dataloader yielded no batches. With drop_last=True "
+                "(the static-shape default) this happens when a shard "
+                "holds fewer rows than batch_size — e.g. a small dataset "
+                "split over many processes. Lower batch_size or grow the "
+                "dataset."
+            ) from None
         if it is loader:
             if self.max_epochs > 1:
                 log.warning(

@@ -96,6 +96,25 @@ def test_seed_determinism(tmp_path):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
 
+def test_predict_one_shot_iterator_keeps_first_batch(tmp_path):
+    """Eval entrypoints on an UNFITTED module peek batch 0 to init params;
+    with a one-shot iterator (generator) the peeked batch must still be
+    predicted — the re-stitched loader from _ensure_state is the one
+    iterated, not the half-consumed original."""
+    from ray_lightning_tpu import SingleDevice, Trainer
+
+    module = BoringModel()
+    data = random_dataset(n=64)
+    batches = list(DataLoader(data, batch_size=16))  # 4 batches
+    trainer = Trainer(
+        strategy=SingleDevice(), enable_progress_bar=False,
+        enable_checkpointing=False, default_root_dir=str(tmp_path), seed=0,
+    )
+    preds = trainer.predict(module, (b for b in batches))
+    assert len(preds) == 4  # batch 0 not swallowed by the init peek
+    assert all(np.asarray(p).shape == (16,) for p in preds)
+
+
 def test_validate_and_test_apis(tmp_path):
     module = BoringModel()
     trainer = get_trainer(tmp_path, SingleDevice(), max_epochs=1)
