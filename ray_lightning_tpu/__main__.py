@@ -147,7 +147,11 @@ def main(argv=None) -> int:
     plan_p.add_argument("--device-kind", default="TPU v5p",
                         choices=("TPU v3", "TPU v4", "TPU v5e", "TPU v5p",
                                  "TPU v6e"))
-    plan_p.add_argument("--json", action="store_true", dest="as_json")
+    # SUPPRESS: the subparser parses into the SAME namespace the parent
+    # already filled — a plain default=False here would overwrite a
+    # `--json` given before the subcommand
+    plan_p.add_argument("--json", action="store_true", dest="as_json",
+                        default=argparse.SUPPRESS)
     args = p.parse_args(argv)
     if args.cmd == "plan":
         return run_plan(args)

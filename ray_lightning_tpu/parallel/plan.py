@@ -182,16 +182,20 @@ def plan_train_memory(
         a_opt = jax.eval_shape(tx.init, a_params)
         o_shardings = strategy.opt_state_shardings(a_opt, a_params)
 
+    if hbm_bytes_per_device is None:
+        if device_kind not in HBM_BYTES_BY_KIND:
+            raise ValueError(
+                f"unknown device_kind {device_kind!r} (known: "
+                f"{sorted(HBM_BYTES_BY_KIND)}); pass "
+                "hbm_bytes_per_device= explicitly for other hardware"
+            )
+        hbm_bytes_per_device = HBM_BYTES_BY_KIND[device_kind]
     params_dev = _sharded_tree_bytes(a_params, p_shardings)
     opt_dev = _sharded_tree_bytes(a_opt, o_shardings)
     return MemoryPlan(
         mesh_axes={k: v for k, v in spec.sizes().items() if v > 1},
         n_devices=n_devices,
-        hbm_bytes_per_device=(
-            hbm_bytes_per_device
-            if hbm_bytes_per_device is not None
-            else HBM_BYTES_BY_KIND[device_kind]
-        ),
+        hbm_bytes_per_device=hbm_bytes_per_device,
         params_bytes_global=_tree_bytes(a_params),
         opt_bytes_global=_tree_bytes(a_opt),
         params_bytes_per_device=params_dev,
@@ -238,8 +242,13 @@ def llama_activation_bytes(cfg, local_batch: int, seq: int) -> int:
 
 
 def dp_degree(spec: MeshSpec) -> int:
-    """Batch divisor of a spec (mirrors mesh_lib.dp_axis_names for specs)."""
-    return math.prod(
-        s for ax, s in spec.sizes().items()
-        if ax in ("data", "fsdp", "expert") and s > 1
-    ) or 1
+    """Batch divisor of a spec (mirrors mesh_lib.dp_axis_names for
+    specs). Requires a RESOLVED spec — a -1 wildcard would silently
+    contribute nothing and undercount the degree."""
+    sizes = spec.sizes()
+    if any(s == -1 for s in sizes.values()):
+        raise ValueError(
+            f"dp_degree needs a resolved spec (call spec.resolve(n) "
+            f"first); got {sizes}"
+        )
+    return math.prod(sizes[ax] for ax in ("data", "fsdp", "expert"))

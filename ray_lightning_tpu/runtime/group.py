@@ -125,6 +125,15 @@ class _HelloAcceptor:
             except OSError:
                 pass
             return
+        if not self._open:
+            # start() already collected its hellos: a late-authenticating
+            # straggler (retried spawn, duplicate rank) must get a reset,
+            # not sit parked forever on a queue nobody reads
+            try:
+                raw.close()
+            except OSError:
+                pass
+            return
         self._conns.put(raw)
 
     def get(self, timeout: float):
@@ -138,6 +147,16 @@ class _HelloAcceptor:
 
     def close(self) -> None:
         self._open = False
+        # drop anything that authenticated after the last get(): holding
+        # it would leave that worker blocked waiting for commands forever
+        while True:
+            conn = self.get(0.0)
+            if conn is None:
+                return
+            try:
+                conn.close()
+            except OSError:
+                pass
 
 
 class WorkerError(RuntimeError):
@@ -416,8 +435,25 @@ class WorkerGroup:
                         "worker connected but sent no hello within "
                         f"{self.start_timeout}s"
                     )
-                cmd, rank, info = conn.recv()
-                assert cmd == "hello", cmd
+                try:
+                    msg = conn.recv()
+                except EOFError:
+                    # authenticated then died mid-hello: abort like every
+                    # other startup failure — leaking the other spawned
+                    # workers would hold their hosts' chips indefinitely
+                    self._abort_start(procs, logs)
+                    raise WorkerError(
+                        -1, "a worker died between authenticating and "
+                        "sending its hello",
+                    ) from None
+                if not (isinstance(msg, tuple) and len(msg) == 3
+                        and msg[0] == "hello"):
+                    self._abort_start(procs, logs)
+                    raise WorkerError(
+                        -1, f"unexpected first message from a worker "
+                        f"(want hello): {msg!r:.200}",
+                    )
+                _, rank, info = msg
                 by_rank[rank] = TpuExecutor(
                     rank, self.num_workers, procs[rank], conn, info,
                     logs[rank], host=self._worker_host(rank),
@@ -586,12 +622,22 @@ class WorkerGroup:
             # (eviction past the cap, or a blob whose parse failed
             # earlier): resend the payload for THIS task and move on
             tid, digest = msg[1], msg[2]
-            if (resend is not None and resend["digest"] == digest
-                    and tids[ex.rank] == tid):
+            if tids[ex.rank] != tid:
+                # stale request from an earlier, already-raised run (cf.
+                # the stale-error drop below): that task's pump is gone,
+                # so just ignore it — the worker moves on with the next
+                # exec it receives
+                log.warning(
+                    "dropping stale need_blob from rank %d (task %s)",
+                    ex.rank, tid,
+                )
+                return
+            if resend is not None and resend["digest"] == digest:
                 ex.conn.send(("exec2", tid, digest, resend["blob"],
                               resend["extras"][ex.rank]))
                 return
-            # unanswerable: without the payload the task can never finish
+            # current task but unanswerable: without the payload the task
+            # can never finish
             raise WorkerError(
                 ex.rank,
                 f"worker requested blob {digest[:12]} for task {tid} but "

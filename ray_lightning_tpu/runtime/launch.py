@@ -47,16 +47,27 @@ def _probe_coordinator_port():
     return port
 
 
-def _await_coordinator(coordinator: str, rank: int,
-                       timeout: float = 60.0) -> None:
+def _await_coordinator(coordinator: str, rank: int) -> None:
     """Bounded preflight from a non-zero rank: the jax coordinator (on
-    worker 0) must become dialable within ``timeout``, else fail with the
+    worker 0) must become dialable within the window, else fail with the
     fix by name — a wrong coordinator address otherwise surfaces as a
     multi-minute opaque barrier hang inside jax.distributed.initialize
-    (VERDICT r3 weak #4 / next #7)."""
+    (VERDICT r3 weak #4 / next #7).
+
+    The window defaults to 60s and is raised via RLT_COORD_PREFLIGHT_S
+    (a slow-but-healthy rank 0 — cold NFS jax import, fat job blob —
+    must not be misdiagnosed as unroutable); <= 0 skips the preflight.
+    """
+    import os
     import socket
     import time
 
+    try:
+        timeout = float(os.environ.get("RLT_COORD_PREFLIGHT_S", "60"))
+    except ValueError:
+        timeout = 60.0
+    if timeout <= 0:
+        return
     host, port = coordinator.rsplit(":", 1)
     deadline = time.monotonic() + timeout
     last_err: Exception | None = None
@@ -71,8 +82,9 @@ def _await_coordinator(coordinator: str, rank: int,
         f"rank {rank}: jax coordinator {coordinator} was unreachable for "
         f"{timeout:.0f}s ({last_err}). In a multi-host job this address "
         "must be a fabric-routable IP of worker 0 — set RLT_NODE_IP in "
-        "worker 0's environment (transport env) to pin the right "
-        "interface, or pass coordinator_address= to launch()."
+        "worker 0's environment (transport host_env) to pin the right "
+        "interface, or pass coordinator_address= to launch(). If worker 0 "
+        "is just slow to start (cold imports), raise RLT_COORD_PREFLIGHT_S."
     )
 
 

@@ -45,12 +45,8 @@ def run_one(tag: str, *, batch: int, policy: str, chunk: int,
         step, params, opt_state, tokens, tps_tokens, cfg = bench._make_step(
             use_flash=True, fused_ce=True, batch=batch, seq=seq,
             vocab=vocab, remat=True, scan=True,
+            remat_policy=policy, ce_chunk_tokens=chunk,
         )
-        # patch policy/chunk via a fresh cfg-bearing step
-        if policy != "nothing" or chunk != 2048:
-            del step, params, opt_state, tokens
-            step, params, opt_state, tokens, tps_tokens, cfg = _make_step2(
-                batch, seq, vocab, policy, chunk)
         dt = bench._time_step(step, params, opt_state, tokens)
         tps = tps_tokens / dt
         import jax
@@ -67,44 +63,6 @@ def run_one(tag: str, *, batch: int, policy: str, chunk: int,
         f.write(json.dumps(rec) + "\n")
     print(json.dumps(rec), flush=True)
     return rec
-
-
-def _make_step2(batch, seq, vocab, policy, chunk):
-    """bench._make_step with remat_policy/ce_chunk_tokens overrides."""
-    import dataclasses
-    from functools import partial
-
-    import jax
-    import numpy as np
-    import optax
-
-    import bench
-    from ray_lightning_tpu.models.llama import Llama, LlamaModule
-
-    cfg = bench._bench_cfg(True, True, seq, vocab, True, True)
-    cfg = dataclasses.replace(cfg, remat_policy=policy,
-                              ce_chunk_tokens=chunk)
-    model = Llama(cfg)
-    module = LlamaModule(cfg)
-    module.model = model
-    tokens = jax.random.randint(
-        jax.random.key(0), (batch, seq + 1), 0, cfg.vocab_size,
-        dtype=np.int32)
-    params = jax.jit(model.init)(jax.random.key(0), tokens[:, :-1])["params"]
-    tx = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
-    opt_state = jax.jit(tx.init)(params)
-
-    def loss_fn(params, tokens):
-        return module._loss(params, tokens[:, :-1], tokens[:, 1:], None)
-
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
-
-    return step, params, opt_state, tokens, batch * seq, cfg
 
 
 def best_so_far():
@@ -129,13 +87,17 @@ def main():
             for batch in (4, 8, 16):
                 run_one(f"p1-{policy}-b{batch}", batch=batch, policy=policy,
                         chunk=2048)
+    b = best_so_far()
+    if b is None:
+        print("BEST: none — no config completed; fix phase 1 first",
+              flush=True)
+        return
     if phase in ("2", "all"):
-        b = best_so_far()
         for chunk in (1024, 4096, 8192):
             run_one(f"p2-chunk{chunk}", batch=b["batch"], policy=b["policy"],
                     chunk=chunk)
-    if phase in ("3", "all"):
         b = best_so_far()
+    if phase in ("3", "all"):
         for bq, bk in ((256, 1024), (512, 512), (1024, 1024), (512, 2048)):
             run_one(f"p3-q{bq}k{bk}", batch=b["batch"], policy=b["policy"],
                     chunk=b["chunk"], block_q=bq, block_k=bk)

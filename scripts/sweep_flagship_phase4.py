@@ -15,4 +15,5 @@ if __name__ == "__main__":
     run_one("p4-q512k2048", batch=8, policy="nothing", chunk=4096,
             block_q=512, block_k=2048)
     run_one("p4-chunk6144", batch=8, policy="nothing", chunk=6144)
-    print("BEST:", json.dumps(best_so_far()), flush=True)
+    best = best_so_far()
+    print("BEST:", json.dumps(best) if best else "none", flush=True)

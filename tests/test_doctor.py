@@ -52,6 +52,13 @@ def test_doctor_plan_subcommand(capsys):
     assert rc == 0 and info["fits"] is True
     assert info["mesh"] == {"fsdp": 64}
 
+    # --json BEFORE the subcommand must work too (the subparser writes
+    # into the same namespace; its default must not clobber the parent's)
+    rc = main(["--json", "plan", "--preset", "llama3-8b", "--fsdp", "64",
+               "--batch", "64", "--seq", "8192"])
+    info = json.loads(capsys.readouterr().out.strip())
+    assert rc == 0 and info["fits"] is True
+
     rc = main(["plan", "--preset", "llama3-8b", "--fsdp", "8",
                "--batch", "8", "--seq", "8192",
                "--device-kind", "TPU v5e"])

@@ -103,6 +103,25 @@ def test_plan_respects_tensor_axis_specs():
     assert plan_t.params_bytes_per_device < 0.2 * plan_t.params_bytes_global
 
 
+def test_planner_input_validation():
+    """Library-API edges: unknown device kinds name the escape hatch
+    instead of KeyError-ing, and dp_degree refuses unresolved specs
+    (a -1 wildcard would silently undercount the batch divisor)."""
+    from ray_lightning_tpu.parallel.mesh import MeshSpec
+    from ray_lightning_tpu.parallel.plan import dp_degree
+
+    cfg = LlamaConfig.tiny()
+    with pytest.raises(ValueError, match="hbm_bytes_per_device"):
+        plan_train_memory(
+            LlamaModule(cfg), ShardedMesh(fsdp=8), n_devices=8,
+            example_batch={"tokens": np.zeros((8, 257), np.int32)},
+            device_kind="TPU v99",
+        )
+    assert dp_degree(MeshSpec(data=2, fsdp=4, tensor=2)) == 8
+    with pytest.raises(ValueError, match="resolved"):
+        dp_degree(MeshSpec(fsdp=-1))
+
+
 def test_planner_initializes_no_backend():
     """The planner's contract: NO jax backend is ever initialized — it
     must work on a box whose accelerator is unreachable (the exact
