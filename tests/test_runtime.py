@@ -372,6 +372,59 @@ def test_dead_worker_fails_start_fast(tmp_path):
     assert _time.monotonic() - t0 < 15  # seconds, not start_timeout
 
 
+def test_worker_dead_after_auth_aborts_start(tmp_path):
+    """A worker that completes the authkey handshake but dies before its
+    hello must abort start() — and kill the OTHER spawned workers — not
+    leak them while the driver raises (full-round review finding)."""
+    import subprocess
+    import sys
+    import time as _time
+
+    from ray_lightning_tpu.runtime.transport import LocalTransport
+
+    class _DiesAfterAuth(LocalTransport):
+        def __init__(self):
+            self.procs = []
+
+        def spawn(self, *, host, connect, env, authkey_hex, log_path):
+            driver_host, port, rank, world = connect
+            if rank == 0:
+                proc = super().spawn(host=host, connect=connect, env=env,
+                                     authkey_hex=authkey_hex,
+                                     log_path=log_path)
+            else:
+                # rank 1: authenticate, send nothing, exit
+                code = (
+                    "import sys\n"
+                    "from multiprocessing.connection import Client\n"
+                    f"Client(({driver_host!r}, {port}), "
+                    f"authkey=bytes.fromhex({authkey_hex!r}))\n"
+                    "sys.exit(0)\n"
+                )
+                with open(log_path, "w") as f:
+                    proc = subprocess.Popen([sys.executable, "-c", code],
+                                            stdout=f,
+                                            stderr=subprocess.STDOUT)
+            self.procs.append(proc)
+            return proc
+
+    transport = _DiesAfterAuth()
+    g = WorkerGroup(2, transport=transport, log_dir=str(tmp_path),
+                    start_timeout=60.0)
+    t0 = _time.monotonic()
+    # match pins the authenticated-then-died EOF branch (the sibling
+    # test covers the died-before-connecting branch)
+    with pytest.raises(WorkerError, match="authenticating"):
+        g.start()
+    assert _time.monotonic() - t0 < 30  # aborted, not start_timeout'd
+    # nothing leaked: the abort killed rank 0's healthy worker too
+    deadline = _time.monotonic() + 10
+    while (any(p.poll() is None for p in transport.procs)
+           and _time.monotonic() < deadline):
+        _time.sleep(0.1)
+    assert all(p.poll() is not None for p in transport.procs)
+
+
 def test_node_ip_env_override(monkeypatch):
     """RLT_NODE_IP pins the advertised interface on multi-homed hosts."""
     from ray_lightning_tpu.runtime.group import routable_ip
