@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import sys
 
 
 def collect(probe: bool = False) -> dict:
@@ -67,6 +68,17 @@ def collect(probe: bool = False) -> dict:
     return info
 
 
+def _plan_invalid(msg: str, as_json: bool) -> int:
+    """The documented exit-status contract: every invalid configuration
+    exits 2 with a structured error, distinguishable by scripted
+    consumers from the meaningful exit-1 'does not fit' verdict."""
+    if as_json:
+        print(json.dumps({"error": msg}))
+    else:
+        print(f"error: {msg}", file=sys.stderr)
+    return 2
+
+
 def run_plan(args) -> int:
     import numpy as np
 
@@ -83,6 +95,14 @@ def run_plan(args) -> int:
         "llama3-8b": LlamaConfig.llama3_8b,
         "tiny": LlamaConfig.tiny,
     }
+    for name in ("data", "fsdp", "tensor", "batch", "seq"):
+        if getattr(args, name) < 1:
+            # a zero/negative axis would ZeroDivisionError below — exit 2,
+            # never a traceback colliding with the exit-1 verdict
+            return _plan_invalid(
+                f"--{name} must be >= 1, got {getattr(args, name)}",
+                args.as_json,
+            )
     cfg = presets[args.preset](
         remat=True, scan_layers=True, fused_ce=True, max_seq_len=args.seq
     )
@@ -92,26 +112,26 @@ def run_plan(args) -> int:
     if args.batch % dp != 0:
         # a clamped/floored local batch would produce a FITS verdict for
         # a job that cannot actually shard its batch — refuse up front
-        import sys
-
-        msg = (f"global batch {args.batch} is not divisible by the "
-               f"data-parallel degree {dp} (data x fsdp); the job could "
-               f"not shard this batch. Pick batch = k x {dp}.")
-        if args.as_json:
-            print(json.dumps({"error": msg}))
-        else:
-            print(f"error: {msg}", file=sys.stderr)
-        return 2
-    plan = plan_train_memory(
-        LlamaModule(cfg),
-        ShardedMesh(data=args.data, fsdp=args.fsdp, tensor=args.tensor),
-        n_devices=n_devices,
-        example_batch={"tokens": np.zeros((args.batch, args.seq + 1),
-                                          np.int32)},
-        activation_bytes_per_device=llama_activation_bytes(
-            cfg, args.batch // dp, args.seq),
-        device_kind=args.device_kind,
-    )
+        return _plan_invalid(
+            f"global batch {args.batch} is not divisible by the "
+            f"data-parallel degree {dp} (data x fsdp); the job could "
+            f"not shard this batch. Pick batch = k x {dp}.",
+            args.as_json,
+        )
+    try:
+        plan = plan_train_memory(
+            LlamaModule(cfg),
+            ShardedMesh(data=args.data, fsdp=args.fsdp, tensor=args.tensor),
+            n_devices=n_devices,
+            example_batch={"tokens": np.zeros((args.batch, args.seq + 1),
+                                              np.int32)},
+            activation_bytes_per_device=llama_activation_bytes(
+                cfg, args.batch // dp, args.seq),
+            device_kind=args.device_kind,
+        )
+    except ValueError as exc:
+        # a mesh the strategy rejects, a planner refusal — same contract
+        return _plan_invalid(str(exc), args.as_json)
     if args.as_json:
         print(json.dumps({
             "mesh": plan.mesh_axes,

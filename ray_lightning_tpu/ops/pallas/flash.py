@@ -365,6 +365,31 @@ def _flash_bwd_rule(scale, causal, q_offset, block_q, block_k, res, do):
 _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+def _env_block(name: str, default: int) -> int:
+    """Tuning-knob env parse: a malformed value falls back to the tuned
+    default with a warning instead of failing the whole training step at
+    trace time (same policy as the bench watchdog's env parse)."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        value = int(raw)
+        if value <= 0:
+            # 0 would divide-by-zero in the grid math, a negative value
+            # would yield a negative block — both kill the step at trace
+            # time, the exact failure this fallback exists to prevent
+            raise ValueError(raw)
+    except ValueError:
+        import warnings
+
+        warnings.warn(
+            f"ignoring malformed {name}={raw!r}; using {default}",
+            stacklevel=2,
+        )
+        return default
+    return value
+
+
 def flash_attention_pallas(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -381,9 +406,9 @@ def flash_attention_pallas(
     overridable per-process via ``RLT_FLASH_BLOCK_Q``/``RLT_FLASH_BLOCK_K``
     (read at trace time — the sweep harness's tuning knob)."""
     if block_q is None:
-        block_q = int(os.environ.get("RLT_FLASH_BLOCK_Q", DEFAULT_BLOCK_Q))
+        block_q = _env_block("RLT_FLASH_BLOCK_Q", DEFAULT_BLOCK_Q)
     if block_k is None:
-        block_k = int(os.environ.get("RLT_FLASH_BLOCK_K", DEFAULT_BLOCK_K))
+        block_k = _env_block("RLT_FLASH_BLOCK_K", DEFAULT_BLOCK_K)
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     qt = q.transpose(0, 2, 1, 3)  # [B, H, S, D]
     kt = k.transpose(0, 2, 1, 3)

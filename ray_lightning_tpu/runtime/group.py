@@ -71,6 +71,20 @@ def routable_ip() -> str:
         return "127.0.0.1"
 
 
+def _split_accept_supported(listener: Listener) -> bool:
+    """True when the stdlib internals the split accept/auth path needs
+    exist: the raw socket listener and the challenge-pair functions."""
+    from multiprocessing import connection as mpc
+
+    raw = getattr(listener, "_listener", None)
+    return (
+        raw is not None
+        and callable(getattr(raw, "accept", None))
+        and callable(getattr(mpc, "deliver_challenge", None))
+        and callable(getattr(mpc, "answer_challenge", None))
+    )
+
+
 class _HelloAcceptor:
     """Accept worker connections without letting any single peer wedge
     startup.
@@ -92,11 +106,40 @@ class _HelloAcceptor:
         self._listener = listener
         self._authkey = authkey
         self._open = True
+        # serializes enqueue-vs-close so a connection that authenticates
+        # concurrently with close() is closed, never stranded on the queue
+        self._lock = threading.Lock()
         self._conns: "queue.Queue" = queue.Queue()
+        # The split accept/auth path rides on stdlib internals
+        # (Listener._listener raw accept; the deliver/answer challenge
+        # pair). Stable across supported CPythons today, but a minor
+        # release could move them — feature-detect and degrade to the
+        # public blocking accept() (auth runs inline on the accept
+        # thread, so one stalled peer serializes — but startup still
+        # works) rather than breaking every driver start.
+        self._split = _split_accept_supported(listener)
+        if not self._split:
+            log.warning(
+                "multiprocessing internals moved (Listener._listener / "
+                "deliver_challenge); using public blocking accept() — a "
+                "stalled peer can delay, though not wedge, startup"
+            )
         threading.Thread(target=self._accept_loop, daemon=True).start()
 
     def _accept_loop(self) -> None:
         while self._open:
+            if not self._split:
+                try:
+                    # public API: socket accept + authkey challenge inline
+                    conn = self._listener.accept()
+                except Exception:  # noqa: BLE001 — closed/auth-fail/transient
+                    if not self._open:
+                        return
+                    log.warning("listener accept failed", exc_info=True)
+                    time.sleep(0.05)
+                    continue
+                self._enqueue(conn)
+                continue
             try:
                 # socket-level accept (internal but stable: returns the
                 # raw Connection, no challenge)
@@ -125,16 +168,21 @@ class _HelloAcceptor:
             except OSError:
                 pass
             return
-        if not self._open:
-            # start() already collected its hellos: a late-authenticating
-            # straggler (retried spawn, duplicate rank) must get a reset,
-            # not sit parked forever on a queue nobody reads
-            try:
-                raw.close()
-            except OSError:
-                pass
-            return
-        self._conns.put(raw)
+        self._enqueue(raw)
+
+    def _enqueue(self, conn) -> None:
+        # under the lock: close() flips _open under the same lock, so a
+        # post-close enqueue is impossible — the straggler (late
+        # authenticator racing the final drain) is closed instead of
+        # being parked forever on a queue nobody reads
+        with self._lock:
+            if self._open:
+                self._conns.put(conn)
+                return
+        try:
+            conn.close()
+        except OSError:
+            pass
 
     def get(self, timeout: float):
         """Next authenticated connection, or None after ``timeout``."""
@@ -146,7 +194,8 @@ class _HelloAcceptor:
             return None
 
     def close(self) -> None:
-        self._open = False
+        with self._lock:
+            self._open = False
         # drop anything that authenticated after the last get(): holding
         # it would leave that worker blocked waiting for commands forever
         while True:
@@ -454,6 +503,24 @@ class WorkerGroup:
                         f"(want hello): {msg!r:.200}",
                     )
                 _, rank, info = msg
+                if not isinstance(rank, int) or rank not in procs:
+                    # an out-of-range rank would KeyError into procs[rank]
+                    # below WITHOUT aborting — leaking every spawned
+                    # worker (and their hosts' chips); fail it like any
+                    # other startup violation
+                    self._abort_start(procs, logs)
+                    raise WorkerError(
+                        rank if isinstance(rank, int) else -1,
+                        f"hello with invalid rank {rank!r} (expected "
+                        f"0..{self.num_workers - 1})",
+                    )
+                if rank in by_rank:
+                    # a duplicate would silently consume a hello slot and
+                    # only surface as the full start_timeout
+                    self._abort_start(procs, logs)
+                    raise WorkerError(
+                        rank, f"duplicate hello for rank {rank}"
+                    )
                 by_rank[rank] = TpuExecutor(
                     rank, self.num_workers, procs[rank], conn, info,
                     logs[rank], host=self._worker_host(rank),

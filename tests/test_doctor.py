@@ -75,3 +75,32 @@ def test_doctor_plan_subcommand(capsys):
                "--fsdp", "64", "--batch", "64", "--json"])
     info = json.loads(capsys.readouterr().out.strip())
     assert rc == 2 and "not divisible" in info["error"]
+
+
+def test_doctor_plan_invalid_mesh_exits_2(capsys):
+    """EVERY invalid configuration honors the documented exit-2 contract
+    — not just batch divisibility: a mesh the model cannot shard
+    (tensor=5 against dim=128) must exit 2 with a structured error, not
+    escape as a traceback indistinguishable from exit-1 "does not fit"
+    (ADVICE r4)."""
+    from ray_lightning_tpu.__main__ import main
+
+    args = ["plan", "--preset", "tiny", "--tensor", "5", "--fsdp", "1",
+            "--data", "1", "--batch", "5", "--seq", "128"]
+    rc = main(args + ["--json"])
+    info = json.loads(capsys.readouterr().out.strip())
+    assert rc == 2 and "partitioned" in info["error"]
+    rc = main(args)
+    captured = capsys.readouterr()
+    assert rc == 2 and "error:" in captured.err
+
+
+def test_doctor_plan_zero_axis_exits_2(capsys):
+    """A zero/negative mesh axis must exit 2, not ZeroDivisionError into
+    an exit-1 traceback a scripted consumer reads as 'does not fit'."""
+    from ray_lightning_tpu.__main__ import main
+
+    rc = main(["plan", "--preset", "tiny", "--data", "0", "--batch", "8",
+               "--seq", "128", "--json"])
+    info = json.loads(capsys.readouterr().out.strip())
+    assert rc == 2 and "--data" in info["error"]

@@ -176,3 +176,31 @@ class TestRope:
             return float(jnp.sum(qr * kr))
 
         assert abs(score(5, 3) - score(10, 8)) < 1e-4
+
+
+def test_flash_block_env_malformed_falls_back(monkeypatch):
+    """A malformed RLT_FLASH_BLOCK_Q/K must fall back to the tuned
+    default with a warning, not raise at trace time and fail the whole
+    training step (ADVICE r4 — same policy as the bench watchdog env)."""
+    from ray_lightning_tpu.ops.pallas import flash as flash_mod
+
+    monkeypatch.setenv("RLT_FLASH_BLOCK_Q", "not-a-number")
+    with pytest.warns(UserWarning, match="RLT_FLASH_BLOCK_Q"):
+        assert flash_mod._env_block(
+            "RLT_FLASH_BLOCK_Q", flash_mod.DEFAULT_BLOCK_Q
+        ) == flash_mod.DEFAULT_BLOCK_Q
+    monkeypatch.setenv("RLT_FLASH_BLOCK_K", "256")
+    assert flash_mod._env_block("RLT_FLASH_BLOCK_K", 128) == 256
+
+
+def test_flash_block_env_nonpositive_falls_back(monkeypatch):
+    """0/negative block sizes are malformed too: 0 divides-by-zero in the
+    grid math at trace time — same fallback-with-warning path."""
+    from ray_lightning_tpu.ops.pallas import flash as flash_mod
+
+    for bad in ("0", "-128"):
+        monkeypatch.setenv("RLT_FLASH_BLOCK_Q", bad)
+        with pytest.warns(UserWarning, match="RLT_FLASH_BLOCK_Q"):
+            assert flash_mod._env_block(
+                "RLT_FLASH_BLOCK_Q", flash_mod.DEFAULT_BLOCK_Q
+            ) == flash_mod.DEFAULT_BLOCK_Q
