@@ -1,0 +1,102 @@
+"""bench.py contract tests: structured failure JSON and backend retry.
+
+Round-4 postmortem (VERDICT r4 weak #1): the TPU backend was unavailable
+when the driver ran the bench, ``jax.devices()`` raised a raw
+``JaxRuntimeError: UNAVAILABLE`` traceback, and the round shipped zero
+perf evidence. The contract under test: EVERY failure mode — hang
+(watchdog), backend-init exception, mid-run OOM — surfaces as ONE
+parseable JSON line with an "error" field (exit 3), never a bare
+traceback.
+"""
+import json
+
+import pytest
+
+import bench
+
+
+def test_backend_retry_recovers_from_transient_failure(monkeypatch):
+    """Transient backend-init failures (flaky tunnel) are retried with
+    backoff; the device comes back on a later attempt."""
+    import jax
+
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("UNAVAILABLE: tunnel mid-wedge")
+        return ["fake-device"]
+
+    monkeypatch.setattr(jax, "devices", flaky)
+    dev = bench._backend_with_retry(tries=4, base_backoff=0.01)
+    assert dev == "fake-device"
+    assert calls["n"] == 3
+
+
+def test_backend_retry_env_knobs(monkeypatch):
+    """RLT_BENCH_INIT_RETRIES/BACKOFF_S size the retry loop (the driver
+    box needs long patience; tests need short); malformed values fall
+    back to defaults rather than crashing the error path itself."""
+    import jax
+
+    calls = {"n": 0}
+
+    def dead():
+        calls["n"] += 1
+        raise RuntimeError("UNAVAILABLE")
+
+    monkeypatch.setattr(jax, "devices", dead)
+    monkeypatch.setenv("RLT_BENCH_INIT_RETRIES", "2")
+    monkeypatch.setenv("RLT_BENCH_INIT_BACKOFF_S", "0.01")
+    with pytest.raises(RuntimeError, match="after 2 attempts"):
+        bench._backend_with_retry()
+    assert calls["n"] == 2
+    assert bench._env_float("RLT_BENCH_INIT_BACKOFF_S", 9.0) == 0.01
+    monkeypatch.setenv("RLT_BENCH_INIT_BACKOFF_S", "junk")
+    assert bench._env_float("RLT_BENCH_INIT_BACKOFF_S", 9.0) == 9.0
+
+
+def test_backend_init_failure_emits_structured_error(monkeypatch, capsys):
+    """main() on an unavailable backend: exit 3 and ONE JSON line with
+    an 'error' naming the exception — the watchdog guards hangs, this
+    guards exceptions (the round-4 failure mode)."""
+
+    def unavailable():
+        raise RuntimeError("UNAVAILABLE: device tunnel down")
+
+    monkeypatch.setattr(bench, "_backend_with_retry", unavailable)
+    monkeypatch.setenv("RLT_BENCH_WATCHDOG_S", "0")  # isolate this path
+    with pytest.raises(SystemExit) as exc_info:
+        bench.main()
+    assert exc_info.value.code == 3
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    obj = json.loads(line)
+    assert obj["value"] == 0.0
+    assert "UNAVAILABLE" in obj["error"]
+    assert obj["metric"] == "llama_0.5b_train_tokens_per_sec_per_chip"
+
+
+def test_mid_run_exception_emits_structured_error(monkeypatch, capsys):
+    """An exception AFTER backend init (compile failure, OOM) takes the
+    same structured path — not only init errors."""
+    monkeypatch.setattr(bench, "_backend_with_retry",
+                        lambda: type("D", (), {"device_kind": "fake"})())
+    monkeypatch.setattr(bench, "_probe_matmul_tflops",
+                        lambda: (_ for _ in ()).throw(
+                            MemoryError("RESOURCE_EXHAUSTED: hbm")))
+    monkeypatch.setenv("RLT_BENCH_WATCHDOG_S", "0")
+    with pytest.raises(SystemExit) as exc_info:
+        bench.main()
+    assert exc_info.value.code == 3
+    obj = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "RESOURCE_EXHAUSTED" in obj["error"]
+
+
+def test_verify_kernels_passes_on_cpu():
+    """The on-chip kernel-parity gate also holds in CPU interpret mode
+    (the same kernel code); errors are reported per check."""
+    out = bench._verify_kernels()
+    assert out["kernels_verified"] is True, out
+    assert set(out["kernel_errors"]) == {
+        "flash_fwd", "flash_bwd", "fused_ce_loss", "fused_ce_grad"}
