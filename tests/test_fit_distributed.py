@@ -182,3 +182,138 @@ def test_distributed_train_load_predict_matrix(tmp_path):
         total += labels.size
     assert total == 256
     assert correct / total > 0.9
+
+
+# --- mid-epoch failure + resume at process scale (SURVEY §5.3's
+# fail-fast + checkpoint-resume story, proven where it exists for) -------
+
+
+def _make_idsum_module():
+    from tests.utils import IdSumModel
+
+    return IdSumModel(lr=1e-2)
+
+
+def _idsum_rows():
+    rng = np.random.default_rng(0)
+    x = np.zeros((64, 8), np.float32)
+    x[:, 0] = np.arange(64)  # row id in column 0
+    y = rng.integers(0, 2, 64).astype(np.int32)
+    return x, y
+
+
+def _make_idsum_data():
+    import jax
+
+    from ray_lightning_tpu import DataLoader
+
+    x, y = _idsum_rows()
+    # unshuffled contiguous shards: 32 rows/process, local batch 8 ->
+    # 4 global steps/epoch; global batch b carries ids
+    # [8b..8b+8) U [32+8b..32+8b+8)
+    return DataLoader(
+        {"x": x, "y": y},
+        batch_size=8,
+        num_shards=jax.process_count(),
+        shard_index=jax.process_index(),
+    )
+
+
+from ray_lightning_tpu import Callback  # noqa: E402 — test-local helpers
+
+
+class _StepCounter(Callback):
+    """Counts batches trained in THIS run and publishes the count as a
+    metric, so the driver can assert how much of the interrupted epoch
+    the resumed run replayed."""
+
+    def __init__(self):
+        self.n = 0
+
+    def on_train_batch_end(self, trainer, module, metrics, batch_idx):
+        self.n += 1
+        trainer.callback_metrics["steps_this_run"] = float(self.n)
+
+
+class _DieAtStep(Callback):
+    """Deterministic mid-epoch 'kill': raises in every worker once the
+    jitted step count reaches `at` — after ModelCheckpoint's batch-end
+    hook has durably written that step's checkpoint."""
+
+    def __init__(self, at: int):
+        self.at = at
+
+    def on_train_batch_end(self, trainer, module, metrics, batch_idx):
+        if trainer.global_step >= self.at:
+            raise RuntimeError(f"injected mid-epoch failure at step {self.at}")
+
+
+def _make_failing_trainer(ckpt_dir):
+    from ray_lightning_tpu import DataParallel, ModelCheckpoint, Trainer
+
+    return Trainer(
+        strategy=DataParallel(),
+        max_epochs=1,
+        enable_progress_bar=False,
+        # order matters: ModelCheckpoint's batch-end hook runs (and
+        # blocks on the write) BEFORE the failure fires
+        callbacks=[ModelCheckpoint(dirpath=ckpt_dir, monitor=None,
+                                   every_n_train_steps=1, save_top_k=-1),
+                   _DieAtStep(2)],
+        seed=0,
+    )
+
+
+def _make_resume_trainer():
+    from ray_lightning_tpu import DataParallel, Trainer
+
+    return Trainer(
+        strategy=DataParallel(),
+        max_epochs=1,
+        enable_progress_bar=False,
+        enable_checkpointing=False,
+        callbacks=[_StepCounter()],
+        seed=0,
+    )
+
+
+@pytest.mark.slow
+def test_distributed_mid_epoch_failure_then_resume(tmp_path):
+    """The fail-fast + resume story at process scale (VERDICT r4 next
+    #5): a 2-process SPMD fit checkpointing every step dies mid-epoch at
+    step 2 of 4 — the driver gets the fail-fast WorkerError with the
+    injected traceback — then a FRESH 2-process group resumes from the
+    step-2 checkpoint and replays exactly the remaining 2 batches of the
+    interrupted epoch (reference discipline: stateful resume,
+    tests/test_ddp.py:116-132)."""
+    from ray_lightning_tpu.runtime import WorkerError
+
+    ckpt_dir = str(tmp_path / "ck")
+    spmd = dict(
+        num_processes=2,
+        platform="cpu",
+        num_cpu_devices_per_process=2,
+        timeout=420,
+    )
+    with pytest.raises(WorkerError, match="injected mid-epoch failure"):
+        fit_distributed(
+            _make_idsum_module, partial(_make_failing_trainer, ckpt_dir),
+            _make_idsum_data, log_dir=str(tmp_path / "logs_a"), **spmd,
+        )
+    import os
+
+    assert sorted(os.listdir(ckpt_dir)) == ["step=1", "step=2"]
+
+    result = fit_distributed(
+        _make_idsum_module, _make_resume_trainer, _make_idsum_data,
+        ckpt_path=os.path.join(ckpt_dir, "step=2"),
+        log_dir=str(tmp_path / "logs_b"), return_weights=False, **spmd,
+    )
+    # exactly the REST of the interrupted epoch: batches 2 and 3, not a
+    # restart from batch 0 and not a skip to epoch end
+    assert result.metrics["steps_this_run"] == 2.0
+    # the final trained batch was the epoch's LAST global batch — ids
+    # 24..31 from rank 0's shard + 56..63 from rank 1's, each row once
+    assert result.metrics["id_sum"] == float(
+        sum(range(24, 32)) + sum(range(56, 64)))
+    assert result.metrics["dup_rows"] == 0.0
