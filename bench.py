@@ -43,7 +43,8 @@ from ray_lightning_tpu.utils.probe import (  # noqa: E402
 
 def _bench_cfg(use_flash: bool, fused_ce: bool, seq: int,
                vocab: int = 32768, remat: bool = True, scan: bool = True,
-               remat_policy: str = "nothing", ce_chunk_tokens: int = 2048):
+               remat_policy: str = "nothing", ce_chunk_tokens: int = 2048,
+               ce_inline: bool = False):
     from ray_lightning_tpu.models.llama import LlamaConfig
 
     return LlamaConfig(
@@ -57,6 +58,7 @@ def _bench_cfg(use_flash: bool, fused_ce: bool, seq: int,
         use_flash=use_flash,
         fused_ce=fused_ce,
         ce_chunk_tokens=ce_chunk_tokens,
+        ce_inline_bwd=ce_inline,
         remat=remat,
         remat_policy=remat_policy,
         scan_layers=scan,
@@ -80,14 +82,15 @@ def _flops_per_token(cfg, seq: int) -> float:
 
 def _make_step(use_flash: bool, fused_ce: bool, batch: int, seq: int,
                vocab: int = 32768, remat: bool = True, scan: bool = True,
-               remat_policy: str = "nothing", ce_chunk_tokens: int = 2048):
+               remat_policy: str = "nothing", ce_chunk_tokens: int = 2048,
+               ce_inline: bool = False):
     import jax
     import optax
 
     from ray_lightning_tpu.models.llama import Llama, LlamaModule
 
     cfg = _bench_cfg(use_flash, fused_ce, seq, vocab, remat, scan,
-                     remat_policy, ce_chunk_tokens)
+                     remat_policy, ce_chunk_tokens, ce_inline)
     model = Llama(cfg)
     module = LlamaModule(cfg)
     module.model = model
@@ -137,10 +140,11 @@ def _time_step(step, params, opt_state, tokens, warmup=3, iters=5,
 
 def _measure(use_flash: bool, fused_ce: bool, batch: int, seq: int,
              vocab: int = 32768, remat: bool = True, scan: bool = True,
-             remat_policy: str = "nothing", ce_chunk_tokens: int = 2048):
+             remat_policy: str = "nothing", ce_chunk_tokens: int = 2048,
+             ce_inline: bool = False):
     step, params, opt_state, tokens, tps, cfg = _make_step(
         use_flash, fused_ce, batch, seq, vocab, remat, scan,
-        remat_policy, ce_chunk_tokens
+        remat_policy, ce_chunk_tokens, ce_inline
     )
     dt = _time_step(step, params, opt_state, tokens)
     del step, params, opt_state, tokens
@@ -254,14 +258,23 @@ def _verify_kernels() -> dict:
     def ce_fused(hidden, w):
         return fused_cross_entropy(hidden, w, targets, chunk_tokens=128)
 
+    def ce_inline(hidden, w):
+        return fused_cross_entropy(hidden, w, targets, chunk_tokens=128,
+                                   inline_backward=True)
+
     (l_ref, g_ref) = jax.value_and_grad(ce_ref, argnums=(0, 1))(hidden, w)
     (l_fus, g_fus) = jax.value_and_grad(ce_fused, argnums=(0, 1))(hidden, w)
+    (l_inl, g_inl) = jax.value_and_grad(ce_inline, argnums=(0, 1))(hidden, w)
     errors["fused_ce_loss"] = abs(float(l_fus) - float(l_ref))
     errors["fused_ce_grad"] = max(
         _rel_err(b, a) for a, b in zip(g_ref, g_fus))
+    errors["inline_ce_loss"] = abs(float(l_inl) - float(l_ref))
+    errors["inline_ce_grad"] = max(
+        _rel_err(b, a) for a, b in zip(g_ref, g_inl))
 
     tolerances = {"flash_fwd": 2e-2, "flash_bwd": 2e-2,
-                  "fused_ce_loss": 2e-2, "fused_ce_grad": 2e-2}
+                  "fused_ce_loss": 2e-2, "fused_ce_grad": 2e-2,
+                  "inline_ce_loss": 2e-2, "inline_ce_grad": 2e-2}
     return {
         "kernels_verified": all(
             errors[kk] <= tolerances[kk] for kk in tolerances),

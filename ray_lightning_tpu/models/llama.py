@@ -81,6 +81,12 @@ class LlamaConfig:
     fused_ce: Optional[bool] = None
     #: logits tile height for the fused CE scan (C×V live logits memory)
     ce_chunk_tokens: int = 1024
+    #: compute the fused CE's gradients inline in the forward scan
+    #: (ops/fused_ce.py _ce_inline) instead of rematerializing each
+    #: logits tile in backward — removes the lm_head recompute tax
+    #: (~one [C, D]×[D, V] pass per step) for ~D×V f32 extra residual
+    #: memory. Only meaningful when the fused path is active.
+    ce_inline_bwd: bool = False
     #: >0 enables the GPipe decoder path (ops/pipeline.py) when the mesh
     #: has pipe > 1: the scanned layer stack is stage-split over `pipe`
     #: and this many microbatches flow through per step. Requires
@@ -544,6 +550,7 @@ class LlamaModule(TpuModule):
                 hidden, w, targets, mask,
                 chunk_tokens=cfg.ce_chunk_tokens,
                 compute_dtype=cfg.dtype,
+                inline_backward=cfg.ce_inline_bwd,
             )
         # materialized logits from the pipelined hidden states — the same
         # math the flax head performs: cfg.dtype matmul (Embed.attend

@@ -25,10 +25,12 @@ reduction order.
 """
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def fused_cross_entropy(
@@ -38,6 +40,7 @@ def fused_cross_entropy(
     mask: Optional[jnp.ndarray] = None,
     chunk_tokens: int = 1024,
     compute_dtype: jnp.dtype = jnp.bfloat16,
+    inline_backward: bool = False,
 ) -> jnp.ndarray:
     """Mean token CE of ``normalize(hidden) @ lm_head`` vs ``targets``.
 
@@ -47,28 +50,29 @@ def fused_cross_entropy(
     targets: [B, S] int labels.
     mask:    optional [B, S] 0/1 validity mask.
     chunk_tokens: logits tile height C; live logits memory is C×V.
+    inline_backward: compute the CE gradients DURING the forward pass
+             (see ``_ce_inline``) instead of rematerializing each logits
+             tile in the backward; trades a D×V residual (the lm_head's
+             dtype) for one fewer [C, D]×[D, V] matmul pass per step.
+             Exact for hidden/lm_head gradients at any cotangent scale.
+             Caveat: the MASK cotangent is zero on this path (the default
+             path differentiates through the mean's weighting) — do not
+             use it with a learnable mask.
 
     Returns the scalar mean loss (f32), masked-token weighted.
     """
-    B, S, D = hidden.shape
-    T = B * S
-    x = hidden.reshape(T, D).astype(compute_dtype)
-    t = targets.reshape(T)
-    m = (jnp.ones((T,), jnp.float32) if mask is None
-         else mask.reshape(T).astype(jnp.float32))
+    if inline_backward:
+        # dtype travels as its NAME: custom_vjp static args must be
+        # plain hashable non-array values (a np.dtype is rejected)
+        return _ce_inline(chunk_tokens, jnp.dtype(compute_dtype).name,
+                          hidden, lm_head, targets,
+                          jnp.ones(targets.shape, jnp.float32)
+                          if mask is None
+                          else mask.astype(jnp.float32))
+    x, t, m, n_chunks, C = _prep_chunks(
+        hidden, targets, mask, chunk_tokens, compute_dtype)
+    D = hidden.shape[-1]
     w = lm_head.astype(compute_dtype)
-
-    # Static tiling: pad T up to a multiple of the tile height with
-    # zero-masked rows (never fall back to one giant tile — an awkward
-    # prime T must not silently materialize the [T, V] logits this
-    # function exists to avoid).
-    C = min(max(1, chunk_tokens), T)
-    pad = (-T) % C
-    if pad:
-        x = jnp.concatenate([x, jnp.zeros((pad, D), x.dtype)])
-        t = jnp.concatenate([t, jnp.zeros((pad,), t.dtype)])
-        m = jnp.concatenate([m, jnp.zeros((pad,), m.dtype)])
-    n_chunks = (T + pad) // C
 
     @jax.checkpoint
     def chunk_loss(x_c, t_c):
@@ -92,3 +96,118 @@ def fused_cross_entropy(
          m.reshape(n_chunks, C)),
     )
     return loss_sum / jnp.maximum(weight_sum, 1.0)
+
+
+def _prep_chunks(hidden, targets, mask, chunk_tokens, compute_dtype):
+    """Shared flatten/cast/pad tiling for both CE paths.
+
+    Static tiling: pad T up to a multiple of the tile height with
+    zero-masked rows (never fall back to one giant tile — an awkward
+    prime T must not silently materialize the [T, V] logits this module
+    exists to avoid). Returns flat (x [T+pad, D], t, m, n_chunks, C).
+    """
+    B, S, D = hidden.shape
+    T = B * S
+    x = hidden.reshape(T, D).astype(compute_dtype)
+    t = targets.reshape(T)
+    m = (jnp.ones((T,), jnp.float32) if mask is None
+         else mask.reshape(T).astype(jnp.float32))
+    C = min(max(1, chunk_tokens), T)
+    pad = (-T) % C
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad, D), x.dtype)])
+        t = jnp.concatenate([t, jnp.zeros((pad,), t.dtype)])
+        m = jnp.concatenate([m, jnp.zeros((pad,), m.dtype)])
+    return x, t, m, (T + pad) // C, C
+
+
+# ---- inline-backward variant ---------------------------------------------
+#
+# The chunked-remat path above pays a pure recompute tax in backward: each
+# [C, V] logits tile is materialized a SECOND time (jax.checkpoint) just to
+# rebuild the softmax, then two more matmuls produce dx and dW — 4 tile
+# matmul passes per step where 3 carry useful FLOPs. At the flagship bench
+# shape (D=2048, V=128256) that recompute is ~10% of the whole training
+# step's executed FLOPs.
+#
+# The fix (the Liger-kernel idea, expressed as XLA-level scan + custom_vjp
+# rather than a hand-written kernel): CE is the ROOT of the loss graph, and
+# its gradient is LINEAR in the upstream cotangent g — so compute
+# (dx, dW) for g=1 during the forward scan, store them as residuals, and
+# have the backward just scale by g. Exact for any g (grad-accumulation
+# scans, loss weighting); no logits tile is ever built twice. Bonus: dW
+# accumulates in f32 across chunks (the autodiff path accumulates the
+# bf16-cast weight's cotangent chunk-by-chunk in bf16).
+#
+# Cost: residual memory dx [T, D] (activation-sized) + dW [D, V] stored in
+# the lm_head's dtype (f32 for this framework's f32-param models) — the
+# same footprint as the weight-grad buffer backward allocates anyway, just
+# live earlier. At 8B/128k-vocab scale that is ~2 GB/chip under fsdp=8,
+# acceptable against the recompute saving; it is NOT the default because
+# tiny-memory configs may prefer the remat path.
+
+
+def _ce_inline_fwd(chunk_tokens, dtype_name, hidden, lm_head, targets, m):
+    compute_dtype = jnp.dtype(dtype_name)
+    B, S, D = hidden.shape
+    T = B * S
+    V = lm_head.shape[1]
+    x, t, mm, n_chunks, C = _prep_chunks(
+        hidden, targets, m, chunk_tokens, compute_dtype)
+    pad = n_chunks * C - T
+    w = lm_head.astype(compute_dtype)
+    # Σm is known BEFORE the scan, so per-chunk dlogits can carry the
+    # final 1/Σm normalization and dW is a plain sum across chunks.
+    weight_sum = mm.sum()
+    inv = 1.0 / jnp.maximum(weight_sum, 1.0)
+
+    def body(dw_acc, inp):
+        x_c, t_c, m_c = inp
+        logits = jnp.dot(x_c, w, preferred_element_type=jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, t_c[:, None], axis=-1)[:, 0]
+        loss_c = ((lse - tgt) * m_c).sum()
+        # d(mean CE)/d(logits) = (softmax - onehot) * m/Σm — computed
+        # here, once, from the tile that is already live
+        coeff = m_c * inv
+        dlogits = jnp.exp(logits - lse[:, None]) * coeff[:, None]
+        dlogits = dlogits.at[jnp.arange(dlogits.shape[0]), t_c].add(-coeff)
+        dlogits = dlogits.astype(compute_dtype)
+        dx_c = jnp.dot(dlogits, w.T, preferred_element_type=jnp.float32)
+        dw_acc = dw_acc + jnp.dot(x_c.T, dlogits,
+                                  preferred_element_type=jnp.float32)
+        return dw_acc, (loss_c, dx_c.astype(hidden.dtype))
+
+    dw, (loss_chunks, dx) = jax.lax.scan(
+        body,
+        jnp.zeros((D, V), jnp.float32),
+        (x.reshape(n_chunks, C, D), t.reshape(n_chunks, C),
+         mm.reshape(n_chunks, C)),
+    )
+    loss = loss_chunks.sum() * inv
+    dx_full = dx.reshape(T + pad, D)[:T].reshape(B, S, D)
+    # residuals must be arrays only (shapes/dtypes are recovered from dx
+    # in bwd; the mask was normalized to f32 at the entry point)
+    return loss, (dx_full, dw.astype(lm_head.dtype))
+
+
+def _ce_inline_bwd(chunk_tokens, dtype_name, res, g):
+    dx, dw = res
+    t_shape = dx.shape[:2]  # targets/mask are [B, S]
+    # integer targets take a float0 cotangent; the mask's true gradient is
+    # unused by every caller (it is a data-validity indicator) — zeros.
+    return (dx * g.astype(dx.dtype), dw * g.astype(dw.dtype),
+            np.zeros(t_shape, jax.dtypes.float0),
+            jnp.zeros(t_shape, jnp.float32))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _ce_inline(chunk_tokens, dtype_name, hidden, lm_head, targets, m):
+    # primal-only call (no differentiation): plain chunked loss, zero
+    # gradient work — the fwd rule below runs only under grad
+    return fused_cross_entropy(hidden, lm_head, targets, m,
+                               chunk_tokens=chunk_tokens,
+                               compute_dtype=jnp.dtype(dtype_name))
+
+
+_ce_inline.defvjp(_ce_inline_fwd, _ce_inline_bwd)

@@ -11,7 +11,9 @@ then flash block sizes (via RLT_FLASH_BLOCK_Q/K) at the incumbent best.
 Appends one JSON line per config to scripts/sweep_flagship_results.jsonl
 so a partial sweep is still a usable record.
 
-Usage: python scripts/sweep_flagship.py [phase]   # phase in {1,2,3,all}
+Usage: python scripts/sweep_flagship.py [phase]
+  phase in {1,2,3,4,all,retry} — 4 sweeps the inline-backward fused CE;
+  "retry" re-runs the points that died on transient remote-compile 500s.
 """
 from __future__ import annotations
 
@@ -28,7 +30,7 @@ RESULTS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 def run_one(tag: str, *, batch: int, policy: str, chunk: int,
             block_q: int | None = None, block_k: int | None = None,
-            vocab: int = 128256, seq: int = 2048):
+            vocab: int = 128256, seq: int = 2048, inline: bool = False):
     import bench
 
     for key, val in (("RLT_FLASH_BLOCK_Q", block_q),
@@ -39,13 +41,13 @@ def run_one(tag: str, *, batch: int, policy: str, chunk: int,
             os.environ[key] = str(val)
     rec = {"tag": tag, "batch": batch, "policy": policy, "chunk": chunk,
            "block_q": block_q, "block_k": block_k, "vocab": vocab,
-           "seq": seq}
+           "seq": seq, "inline": inline}
     t0 = time.time()
     try:
         step, params, opt_state, tokens, tps_tokens, cfg = bench._make_step(
             use_flash=True, fused_ce=True, batch=batch, seq=seq,
             vocab=vocab, remat=True, scan=True,
-            remat_policy=policy, ce_chunk_tokens=chunk,
+            remat_policy=policy, ce_chunk_tokens=chunk, ce_inline=inline,
         )
         dt = bench._time_step(step, params, opt_state, tokens)
         tps = tps_tokens / dt
@@ -101,6 +103,34 @@ def main():
         for bq, bk in ((256, 1024), (512, 512), (1024, 1024), (512, 2048)):
             run_one(f"p3-q{bq}k{bk}", batch=b["batch"], policy=b["policy"],
                     chunk=b["chunk"], block_q=bq, block_k=bk)
+        b = best_so_far()
+    if phase in ("4", "all"):
+        # inline-backward fused CE (ops/fused_ce.py _ce_inline): removes
+        # the lm_head tile recompute (~10% of executed FLOPs at this
+        # shape) for a dW residual in the lm_head param dtype (f32 here:
+        # ~1 GB at D=2048, V=128256); sweep batch x chunk around the
+        # incumbent
+        inline_recs = []
+        for batch in (4, 8, 12, 16):
+            inline_recs.append(
+                run_one(f"p4-inline-b{batch}", batch=batch,
+                        policy=b["policy"], chunk=b["chunk"], inline=True))
+        done = [r for r in inline_recs if "tokens_per_sec" in r]
+        if done:
+            # chunk sweep continues from the best INLINE point (inline
+            # stays True — an inline-loses-overall outcome must not
+            # silently re-run non-inline configs under a p4 tag)
+            bi = max(done, key=lambda r: r["tokens_per_sec"])
+            for chunk in (2048, 8192, 16384):
+                run_one(f"p4-inline-chunk{chunk}", batch=bi["batch"],
+                        policy=bi["policy"], chunk=chunk, inline=True)
+    if phase == "retry":
+        # re-run the points that died on transient remote-compile HTTP
+        # 500s (VERDICT r4 weak #2) — unknowns, not losers
+        run_one("p1-nothing-b16.r", batch=16, policy="nothing", chunk=2048)
+        run_one("p1-dots-b8.r", batch=8, policy="dots", chunk=2048)
+        run_one("p1-dots-b16.r", batch=16, policy="dots", chunk=2048)
+        run_one("p2-chunk8192.r", batch=8, policy="nothing", chunk=8192)
     print("BEST:", json.dumps(best_so_far()), flush=True)
 
 

@@ -99,4 +99,5 @@ def test_verify_kernels_passes_on_cpu():
     out = bench._verify_kernels()
     assert out["kernels_verified"] is True, out
     assert set(out["kernel_errors"]) == {
-        "flash_fwd", "flash_bwd", "fused_ce_loss", "fused_ce_grad"}
+        "flash_fwd", "flash_bwd", "fused_ce_loss", "fused_ce_grad",
+        "inline_ce_loss", "inline_ce_grad"}
