@@ -76,6 +76,7 @@ class TestLlamaForward:
         np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_u),
                                    atol=2e-5)
 
+    @pytest.mark.slow  # 3 full forward compiles of the same model
     def test_remat_policies_equivalent(self):
         """remat off / full / dots-saveable are schedule choices, not math:
         losses and grads must agree."""

@@ -52,6 +52,7 @@ def test_resnet18_trains_dp(devices8, tmp_path):
     assert float(trainer.callback_metrics["val_acc"]) >= 0.5
 
 
+@pytest.mark.slow  # ~100s: the deepest compile in the suite (50 conv layers)
 def test_resnet50_builds_and_steps(devices8, tmp_path):
     data = synthetic_cifar(n=16)
     module = ResNetModule(variant="resnet50", num_classes=4, lr=0.01,

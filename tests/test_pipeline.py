@@ -116,10 +116,8 @@ def _llama_cfg(**kw):
         remat=False, **kw)
 
 
-@pytest.mark.parametrize(
-    "fused",
-    [False, pytest.param(True, marks=pytest.mark.slow)],  # ~50s compile each
-)
+@pytest.mark.slow  # ~50-100s compile each: double-compile (pipe + scan)
+@pytest.mark.parametrize("fused", [False, True])
 def test_llama_pipeline_matches_scan_path(devices8, fused):
     """The GPipe decoder path trains the SAME stacked params as the scan
     path: losses and grads must agree (pipeline is a schedule)."""
