@@ -342,8 +342,15 @@ def _run() -> dict:
     probe = _probe_matmul_tflops()
 
     # on-chip kernel correctness gate (cheap; before the throughput legs
-    # so a wrong kernel is flagged even if a later leg OOMs)
-    kernels = _verify_kernels()
+    # so a wrong kernel is flagged even if a later leg OOMs). A CRASHING
+    # kernel (raises, not just wrong numbers) must report as a failed
+    # gate, not void the throughput legs that don't use it.
+    try:
+        kernels = _verify_kernels()
+    except Exception as exc:  # noqa: BLE001 — the gate result is data
+        kernels = {"kernels_verified": False,
+                   "kernel_verify_error": f"{type(exc).__name__}: "
+                                          f"{str(exc)[:300]}"}
 
     # Tuned configs per leg, from the v5e sweeps (batch 2..16; chunk
     # 1k..24k; remat on/off x nothing/dots; scan on/off):
@@ -357,74 +364,108 @@ def _run() -> dict:
     #   * the V=128256 leg is where fused CE pays: the materialized
     #     [B, S, V] logits do not even compile there (verified OOM), so
     #     fused is the ONLY path and is reported with its own MFU.
+    # headline leg — fatal on failure (the driver schema requires it)
     tps, cfg = _measure(use_flash=True, fused_ce=False, batch=9, seq=2048,
                         remat=False, scan=False)
     fpt = _flops_per_token(cfg, 2048)
     mfu = tps * fpt / (peak_tflops * 1e12)
 
-    # baseline: every hand-tuned path off — XLA-naive attention, default
-    # remat/scan, at ITS swept-best batch (6; larger batches OOM the S^2
-    # score matrices)
-    base_tps, _ = _measure(use_flash=False, fused_ce=False, batch=6, seq=2048)
-
-    # long-sequence leg (2× context)
-    s4k_tps, s4k_cfg = _measure(use_flash=True, fused_ce=False,
-                                batch=3, seq=4096, remat=False, scan=False)
-    s4k_mfu = s4k_tps * _flops_per_token(s4k_cfg, 4096) / (peak_tflops * 1e12)
-
-    # Llama-3-vocab leg (V=128256): fused chunked CE (ops/fused_ce.py)
-    v128k_tps, v128k_cfg = _measure(use_flash=True, fused_ce=True,
-                                    batch=4, seq=2048, vocab=128256,
-                                    remat=False, scan=False)
-    v128k_mfu = (v128k_tps * _flops_per_token(v128k_cfg, 2048)
-                 / (peak_tflops * 1e12))
-
-    # FLAGSHIP leg: remat + scan_layers + fused CE at the Llama-3 vocab —
-    # the only configuration class that holds at the north-star
-    # Llama-3-8B (BASELINE.md config 4: remat+scan+FSDP are mandatory at
-    # 8B on real chips), benched first-class at its swept optimum
-    # (scripts/sweep_flagship.py: remat_policy x batch x ce_chunk x flash
-    # blocks under remat). MFU counts useful FLOPs only — the backward
-    # recompute remat performs is real work the flagship deliberately
-    # trades for memory, so its MFU reads lower than the unrolled legs.
-    flag_tps, flag_cfg = _measure(
-        use_flash=True, fused_ce=True, batch=8, seq=2048, vocab=128256,
-        remat=True, scan=True, remat_policy="nothing",
-        ce_chunk_tokens=4096,
-    )
-    flag_mfu = (flag_tps * _flops_per_token(flag_cfg, 2048)
-                / (peak_tflops * 1e12))
-
-    # Self-consistency (VERDICT r3 weak #1): the probe is a THROUGHPUT
-    # ceiling; any model leg reading more effective FLOP/s than the bare
-    # matmul chain means one of the two mismeasured. Flag it in-line
-    # rather than shipping arithmetic that cannot all be true.
-    best_model_tflops = max(
-        mfu, s4k_mfu, v128k_mfu, flag_mfu) * peak_tflops
-    probe_consistent = probe >= 0.95 * best_model_tflops
-
-    return {
+    results = {
         "metric": "llama_0.5b_train_tokens_per_sec_per_chip",
         "value": round(tps, 1),
         "unit": "tokens/sec",
-        "vs_baseline": round(tps / base_tps, 4),
+        # overwritten by the baseline leg; on baseline failure it stays
+        # 0.0 NEXT TO a vs_baseline_error field — the same "0.0 means
+        # not-measured" convention as the watchdog/error JSON lines (the
+        # field is required by the driver schema, so it is never dropped)
+        "vs_baseline": 0.0,
         "mfu": round(mfu, 4),
         "assumed_peak_tflops": peak_tflops,
         "device_kind": kind,
         "flops_per_token": round(fpt / 1e9, 3),  # GFLOP
         "probe_matmul_tflops": round(probe, 1),
-        "probe_consistent": probe_consistent,
         **kernels,
-        "s4096_tokens_per_sec": round(s4k_tps, 1),
-        "s4096_mfu": round(s4k_mfu, 4),
-        "v128k_tokens_per_sec": round(v128k_tps, 1),
-        "v128k_mfu": round(v128k_mfu, 4),
-        "v128k_materialized_logits": "OOM (does not compile)",
-        "flagship_tokens_per_sec": round(flag_tps, 1),
-        "flagship_mfu": round(flag_mfu, 4),
-        "flagship_config": "remat(nothing)+scan+fusedCE "
-                           "B=8 S=2048 V=128256 chunk=4096",
     }
+    mfus = [mfu]
+
+    def leg(name, fn):
+        """Secondary legs degrade to a ``<name>_error`` field instead of
+        voiding the whole artifact (one OOMing config must not cost the
+        round every other number, the round-4 lesson at bench level)."""
+        try:
+            results.update(fn())
+        except Exception as exc:  # noqa: BLE001 — leg failures are data
+            results[f"{name}_error"] = f"{type(exc).__name__}: {str(exc)[:300]}"
+
+    def _baseline():
+        # every hand-tuned path off — XLA-naive attention, default
+        # remat/scan, at ITS swept-best batch (6; larger batches OOM the
+        # S^2 score matrices)
+        base_tps, _ = _measure(use_flash=False, fused_ce=False, batch=6,
+                               seq=2048)
+        return {"vs_baseline": round(tps / base_tps, 4)}
+
+    def _s4k():
+        # long-sequence leg (2× context)
+        t, c = _measure(use_flash=True, fused_ce=False, batch=3, seq=4096,
+                        remat=False, scan=False)
+        m = t * _flops_per_token(c, 4096) / (peak_tflops * 1e12)
+        mfus.append(m)
+        return {"s4096_tokens_per_sec": round(t, 1), "s4096_mfu": round(m, 4)}
+
+    def _v128k():
+        # Llama-3-vocab leg (V=128256): fused chunked CE (ops/fused_ce.py)
+        t, c = _measure(use_flash=True, fused_ce=True, batch=4, seq=2048,
+                        vocab=128256, remat=False, scan=False)
+        m = t * _flops_per_token(c, 2048) / (peak_tflops * 1e12)
+        mfus.append(m)
+        return {"v128k_tokens_per_sec": round(t, 1), "v128k_mfu": round(m, 4),
+                "v128k_materialized_logits": "OOM (does not compile)"}
+
+    def _flagship():
+        # FLAGSHIP leg: remat + scan_layers + fused CE at the Llama-3
+        # vocab — the only configuration class that holds at the
+        # north-star Llama-3-8B (BASELINE.md config 4: remat+scan+FSDP
+        # are mandatory at 8B on real chips), benched at its swept
+        # optimum (scripts/sweep_flagship.py) with the inline-backward
+        # CE (ops/fused_ce.py _ce_inline — no logits-tile recompute).
+        # MFU counts useful FLOPs only: the backward recompute remat
+        # performs is real work the flagship deliberately trades for
+        # memory, so its MFU reads lower than the unrolled legs.
+        t, c = _measure(use_flash=True, fused_ce=True, batch=8, seq=2048,
+                        vocab=128256, remat=True, scan=True,
+                        remat_policy="nothing", ce_chunk_tokens=4096,
+                        ce_inline=True)
+        m = t * _flops_per_token(c, 2048) / (peak_tflops * 1e12)
+        mfus.append(m)
+        return {"flagship_tokens_per_sec": round(t, 1),
+                "flagship_mfu": round(m, 4),
+                "flagship_config": "remat(nothing)+scan+fusedCE(inline) "
+                                   "B=8 S=2048 V=128256 chunk=4096"}
+
+    def _flagship_remat_ce():
+        # the pre-inline flagship config, kept as its own leg so the
+        # inline win is visible in one artifact
+        t, c = _measure(use_flash=True, fused_ce=True, batch=8, seq=2048,
+                        vocab=128256, remat=True, scan=True,
+                        remat_policy="nothing", ce_chunk_tokens=4096)
+        m = t * _flops_per_token(c, 2048) / (peak_tflops * 1e12)
+        mfus.append(m)
+        return {"flagship_rematce_tokens_per_sec": round(t, 1),
+                "flagship_rematce_mfu": round(m, 4)}
+
+    leg("vs_baseline", _baseline)
+    leg("s4096", _s4k)
+    leg("v128k", _v128k)
+    leg("flagship", _flagship)
+    leg("flagship_rematce", _flagship_remat_ce)
+
+    # Self-consistency (VERDICT r3 weak #1): the probe is a THROUGHPUT
+    # ceiling; any model leg reading more effective FLOP/s than the bare
+    # matmul chain means one of the two mismeasured. Flag it in-line
+    # rather than shipping arithmetic that cannot all be true.
+    results["probe_consistent"] = probe >= 0.95 * max(mfus) * peak_tflops
+    return results
 
 
 if __name__ == "__main__":

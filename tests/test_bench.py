@@ -101,3 +101,57 @@ def test_verify_kernels_passes_on_cpu():
     assert set(out["kernel_errors"]) == {
         "flash_fwd", "flash_bwd", "fused_ce_loss", "fused_ce_grad",
         "inline_ce_loss", "inline_ce_grad"}
+
+
+def test_secondary_leg_failure_degrades_not_fatal(monkeypatch):
+    """One OOMing secondary leg must cost only its own fields
+    (<leg>_error), never the headline or the other legs — the round-4
+    lesson applied at leg granularity."""
+
+    def fake_measure(use_flash, fused_ce, batch, seq, vocab=32768,
+                     remat=True, scan=True, remat_policy="nothing",
+                     ce_chunk_tokens=2048, ce_inline=False):
+        if vocab == 128256 and not remat:
+            raise MemoryError("RESOURCE_EXHAUSTED: hbm")  # the v128k leg
+        cfg = bench._bench_cfg(use_flash, fused_ce, seq, vocab, remat,
+                               scan, remat_policy, ce_chunk_tokens,
+                               ce_inline)
+        return 1000.0, cfg
+
+    monkeypatch.setattr(bench, "_measure", fake_measure)
+    monkeypatch.setattr(bench, "_verify_kernels",
+                        lambda: {"kernels_verified": True,
+                                 "kernel_errors": {}})
+    monkeypatch.setattr(bench, "_probe_matmul_tflops", lambda: 1e6)
+    monkeypatch.setattr(
+        bench, "_backend_with_retry",
+        lambda: type("D", (), {"device_kind": "fake"})())
+    out = bench._run()
+    assert out["value"] > 0  # headline intact
+    assert "RESOURCE_EXHAUSTED" in out["v128k_error"]
+    assert "v128k_mfu" not in out
+    assert out["vs_baseline"] == 1.0  # baseline leg intact
+    assert "flagship_mfu" in out and "flagship_rematce_mfu" in out
+    assert out["probe_consistent"] is True
+
+
+def test_kernel_verify_crash_degrades_not_fatal(monkeypatch):
+    """A CRASHING kernel gate (raises, not just wrong numbers) reports
+    kernels_verified=False + kernel_verify_error; throughput legs that
+    don't use the kernel still land in the artifact."""
+
+    def fake_measure(*a, **k):
+        return 1000.0, bench._bench_cfg(True, False, 2048)
+
+    monkeypatch.setattr(bench, "_measure", fake_measure)
+    monkeypatch.setattr(
+        bench, "_verify_kernels",
+        lambda: (_ for _ in ()).throw(RuntimeError("pallas crashed")))
+    monkeypatch.setattr(bench, "_probe_matmul_tflops", lambda: 1e6)
+    monkeypatch.setattr(
+        bench, "_backend_with_retry",
+        lambda: type("D", (), {"device_kind": "fake"})())
+    out = bench._run()
+    assert out["value"] > 0
+    assert out["kernels_verified"] is False
+    assert "pallas crashed" in out["kernel_verify_error"]
