@@ -59,6 +59,9 @@ def main():
     p.add_argument("--fused-ce", choices=["auto", "on", "off"],
                    default="auto",
                    help="chunked lm_head+CE; auto = on for vocab >= 64k")
+    p.add_argument("--ce-inline-bwd", action="store_true",
+                   help="compute CE grads inline in the forward scan "
+                        "(no logits-tile recompute; +D x V residual)")
     p.add_argument("--smoke-test", action="store_true")
     args = p.parse_args()
 
@@ -106,6 +109,7 @@ def main():
         remat_policy=args.remat_policy,
         scan_layers=not args.no_scan_layers,
         fused_ce={"auto": None, "on": True, "off": False}[args.fused_ce],
+        ce_inline_bwd=args.ce_inline_bwd,
         pipeline_microbatches=args.microbatches if args.pipe > 1 else 0,
     )
 

@@ -126,7 +126,8 @@ def run_plan(args) -> int:
             example_batch={"tokens": np.zeros((args.batch, args.seq + 1),
                                               np.int32)},
             activation_bytes_per_device=llama_activation_bytes(
-                cfg, args.batch // dp, args.seq),
+                cfg, args.batch // dp, args.seq,
+                weight_shard_degree=args.fsdp * args.tensor),
             device_kind=args.device_kind,
         )
     except ValueError as exc:

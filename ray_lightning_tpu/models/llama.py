@@ -105,6 +105,16 @@ class LlamaConfig:
                 f"remat_policy must be 'nothing' or 'dots', got "
                 f"{self.remat_policy!r}"
             )
+        if self.ce_inline_bwd and not (
+                self.fused_ce is True
+                or (self.fused_ce is None and self.vocab_size >= 2**16)):
+            # a silent no-op flag would let a user believe they measured
+            # the inline path (and the planner charge for residuals that
+            # never exist) — refuse the combination instead
+            raise ValueError(
+                "ce_inline_bwd requires the fused CE path: set "
+                "fused_ce=True (or leave it auto with vocab >= 64k)"
+            )
         if self.pipeline_microbatches > 0 and not self.scan_layers:
             raise ValueError(
                 "pipeline_microbatches requires scan_layers=True (the "

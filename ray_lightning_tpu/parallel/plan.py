@@ -209,7 +209,8 @@ def plan_train_memory(
     )
 
 
-def llama_activation_bytes(cfg, local_batch: int, seq: int) -> int:
+def llama_activation_bytes(cfg, local_batch: int, seq: int,
+                           weight_shard_degree: int = 1) -> int:
     """Activation-footprint bound for the flagship train step —
     remat=True (policy "nothing") + scan_layers + fused CE, the only
     configuration class that holds at 8B (models/llama.py):
@@ -223,6 +224,14 @@ def llama_activation_bytes(cfg, local_batch: int, seq: int) -> int:
       * loss tail: embedding output + final hidden [B,S,D] (bf16 + f32
         copy) and the fused-CE live tile, chunk x V bf16 logits x2
         (recompute + grad);
+      * ce_inline_bwd adds its residuals: dx [B·S, D] (hidden dtype) and
+        the f32 dW accumulator [D, V] (ops/fused_ce.py _ce_inline) —
+        live from the forward scan until the optimizer update. Under
+        SPMD the accumulator inherits the lm_head grad's sharding, so
+        pass ``weight_shard_degree`` (the fsdp×tensor product) to charge
+        the per-device shard instead of the full [D, V] — a ~3 GB
+        overcharge at 8B scale would otherwise flip the exact flagship
+        FSDP config this path was built for to DOES-NOT-FIT;
       * 1.5x slack for allocator fragmentation and XLA temporaries.
 
     Deliberately an over-estimate: a plan that passes here compiles with
@@ -238,6 +247,9 @@ def llama_activation_bytes(cfg, local_batch: int, seq: int) -> int:
     ) * 2 * 2
     ce = (cfg.ce_chunk_tokens * cfg.vocab_size * 2 * 2
           + bs * cfg.dim * (2 + 4))
+    if getattr(cfg, "ce_inline_bwd", False):
+        ce += (bs * cfg.dim * 2
+               + cfg.dim * cfg.vocab_size * 4 // max(1, weight_shard_degree))
     return int(1.5 * (saved + live + ce))
 
 

@@ -203,8 +203,6 @@ class TestInlineBackward:
     def test_module_end_to_end_grads(self):
         """LlamaModule(ce_inline_bwd=True): full train-step grads match
         the default fused path's on the same params/batch."""
-        import optax
-
         def make(inline):
             cfg = LlamaConfig.tiny(fused_ce=True, ce_chunk_tokens=16,
                                    ce_inline_bwd=inline, dtype=jnp.float32)
@@ -231,3 +229,15 @@ class TestInlineBackward:
         for a, b in zip(flat_a, flat_b):
             np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                        atol=2e-5)
+
+
+def test_inline_without_fused_ce_is_refused():
+    """ce_inline_bwd on a config whose fused CE resolves OFF must raise
+    at construction — a silent no-op would let users believe they
+    measured the inline path (and the planner charge for residuals that
+    never exist)."""
+    with pytest.raises(ValueError, match="ce_inline_bwd"):
+        LlamaConfig.tiny(ce_inline_bwd=True)  # auto-off at vocab=256
+    with pytest.raises(ValueError, match="ce_inline_bwd"):
+        LlamaConfig.tiny(fused_ce=False, ce_inline_bwd=True)
+    LlamaConfig.tiny(fused_ce=True, ce_inline_bwd=True)  # explicit: fine

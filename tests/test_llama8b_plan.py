@@ -247,3 +247,19 @@ def test_8b_program_compiles_on_virtual_mesh(devices8, fsdp, tensor):
     # donation wired through: outputs alias the donated state
     assert mem.alias_size_in_bytes > 0.9 * expected_args
     assert mem.temp_size_in_bytes > 0  # activations/workspace planned
+
+def test_activation_bytes_counts_inline_ce_residuals():
+    """ce_inline_bwd trades recompute for residual memory (dx + f32 dW);
+    the planner must charge for it, or an inline-CE plan could read FITS
+    on a chip the dW accumulator alone would overflow."""
+    from ray_lightning_tpu.models.llama import LlamaConfig
+
+    base = LlamaConfig.llama3_8b(remat=True, scan_layers=True,
+                                 fused_ce=True, max_seq_len=8192)
+    inline = LlamaConfig.llama3_8b(remat=True, scan_layers=True,
+                                   fused_ce=True, max_seq_len=8192,
+                                   ce_inline_bwd=True)
+    a = llama_activation_bytes(base, local_batch=1, seq=8192)
+    b = llama_activation_bytes(inline, local_batch=1, seq=8192)
+    # at least the f32 [D, V] accumulator (x1.5 slack), ~3 GB at 8B scale
+    assert b - a >= 1.5 * base.dim * base.vocab_size * 4
