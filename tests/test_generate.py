@@ -82,6 +82,7 @@ def test_module_level_generate(tiny):
     assert np.asarray(out).shape == (2, 3)
 
 
+@pytest.mark.slow  # second full decode compile; scan-variant stays non-slow
 def test_generate_nonscan_layers():
     """The per-layer (non-scan) code path decodes identically too."""
     import dataclasses

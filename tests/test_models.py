@@ -68,6 +68,7 @@ def test_resnet50_builds_and_steps(devices8, tmp_path):
     assert module.num_params() > 2e7  # it really is a ResNet-50
 
 
+@pytest.mark.slow  # ~30s compile; bert coverage stays via padding-mask test
 def test_bert_finetune_dp(devices8, tmp_path):
     data = synthetic_text()
     cfg = BertConfig.tiny(use_flash=False, dropout=0.0)
