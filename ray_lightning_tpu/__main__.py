@@ -104,7 +104,8 @@ def run_plan(args) -> int:
                 args.as_json,
             )
     cfg = presets[args.preset](
-        remat=True, scan_layers=True, fused_ce=True, max_seq_len=args.seq
+        remat=True, scan_layers=True, fused_ce=True, max_seq_len=args.seq,
+        ce_inline_bwd=args.ce_inline_bwd,
     )
     n_devices = args.data * args.fsdp * args.tensor
     dp = dp_degree(MeshSpec(data=args.data, fsdp=args.fsdp,
@@ -168,6 +169,9 @@ def main(argv=None) -> int:
     plan_p.add_argument("--device-kind", default="TPU v5p",
                         choices=("TPU v3", "TPU v4", "TPU v5e", "TPU v5p",
                                  "TPU v6e"))
+    plan_p.add_argument("--ce-inline-bwd", action="store_true",
+                        help="plan with the inline-backward fused CE "
+                             "(charges its dx + sharded dW residuals)")
     # SUPPRESS: the subparser parses into the SAME namespace the parent
     # already filled — a plain default=False here would overwrite a
     # `--json` given before the subcommand

@@ -104,3 +104,21 @@ def test_doctor_plan_zero_axis_exits_2(capsys):
                "--seq", "128", "--json"])
     info = json.loads(capsys.readouterr().out.strip())
     assert rc == 2 and "--data" in info["error"]
+
+
+def test_doctor_plan_ce_inline_flag(capsys):
+    """--ce-inline-bwd plans the inline-CE config: residuals charged
+    (sharded dW — the fsdp x tensor degree divides the [D, V] term), and
+    the 8B FSDP north-star still fits with it on."""
+    from ray_lightning_tpu.__main__ import main
+
+    base = ["plan", "--preset", "llama3-8b", "--fsdp", "64",
+            "--batch", "64", "--seq", "8192", "--device-kind", "TPU v5p",
+            "--json"]
+    rc = main(base)
+    a = json.loads(capsys.readouterr().out.strip())
+    rc2 = main(base + ["--ce-inline-bwd"])
+    b = json.loads(capsys.readouterr().out.strip())
+    assert rc == 0 and rc2 == 0
+    assert b["fits"] is True
+    assert b["per_device_bytes"] > a["per_device_bytes"]
