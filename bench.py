@@ -204,12 +204,24 @@ def _verify_kernels() -> dict:
     import jax
     import jax.numpy as jnp
 
+    from ray_lightning_tpu.ops import dispatch
     from ray_lightning_tpu.ops.attention import dot_product_attention
     from ray_lightning_tpu.ops.fused_ce import fused_cross_entropy
     from ray_lightning_tpu.ops.pallas.flash import flash_attention_pallas
 
     rng = np.random.default_rng(7)
-    B, S, H, Hk, D = 2, 256, 4, 2, 64
+    if dispatch.on_tpu():
+        # on the real chip: the PRODUCTION tile path — flagship head_dim,
+        # tuned default blocks, and the production S=2048 so there are
+        # >= 2 KV tiles (the cross-tile online-softmax rescaling only
+        # runs with multiple KV blocks — a single-tile shape would pass
+        # the gate even with that path broken). Cheap on the MXU.
+        B, S, H, Hk, D = 2, 2048, 4, 2, 128
+        block_q, block_k = None, None  # tuned defaults (512/1024)
+    else:
+        # CPU interpret mode: same kernel code, sized to stay fast
+        B, S, H, Hk, D = 2, 256, 4, 2, 64
+        block_q, block_k = 128, 128
     q = jnp.asarray(rng.standard_normal((B, S, H, D), dtype=np.float32))
     k = jnp.asarray(rng.standard_normal((B, S, Hk, D), dtype=np.float32))
     v = jnp.asarray(rng.standard_normal((B, S, Hk, D), dtype=np.float32))
@@ -223,7 +235,7 @@ def _verify_kernels() -> dict:
     # flash forward (GQA shape, causal — the model's configuration)
     ref = dot_product_attention(q, k, v, causal=True)
     out = flash_attention_pallas(q, k, v, causal=True,
-                                 block_q=128, block_k=128)
+                                 block_q=block_q, block_k=block_k)
     errors["flash_fwd"] = _rel_err(out, ref)
 
     # flash backward: grads of the same scalar through both paths
@@ -232,7 +244,8 @@ def _verify_kernels() -> dict:
 
     def loss_flash(q, k, v):
         return (flash_attention_pallas(
-            q, k, v, causal=True, block_q=128, block_k=128) ** 2).sum()
+            q, k, v, causal=True, block_q=block_q,
+            block_k=block_k) ** 2).sum()
 
     gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
