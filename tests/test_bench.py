@@ -155,3 +155,29 @@ def test_kernel_verify_crash_degrades_not_fatal(monkeypatch):
     assert out["value"] > 0
     assert out["kernels_verified"] is False
     assert "pallas crashed" in out["kernel_verify_error"]
+
+
+def test_watchdog_fires_on_hang():
+    """A hang anywhere in the run (wedged device tunnel: every op blocks
+    forever) must yield the structured error JSON and exit 3 within the
+    watchdog window — the documented contract for the hang mode."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import bench, time\n"
+        "bench._backend_with_retry = lambda **k: time.sleep(60)\n"
+        "bench.main()\n"
+    )
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=30,
+        env={**os.environ, "RLT_BENCH_WATCHDOG_S": "3"},
+        cwd=repo_root,
+    )
+    assert p.returncode == 3, (p.returncode, p.stderr[-500:])
+    obj = json.loads(p.stdout.strip().splitlines()[-1])
+    assert "did not complete" in obj["error"]
+    assert obj["value"] == 0.0
