@@ -24,8 +24,13 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-RESULTS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                       "sweep_flagship_results.jsonl")
+# RLT_SWEEP_RESULTS overrides the record path (CPU smoke runs of the
+# harness itself must not pollute the real chip record)
+RESULTS = os.environ.get(
+    "RLT_SWEEP_RESULTS",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "sweep_flagship_results.jsonl"),
+)
 
 
 def run_one(tag: str, *, batch: int, policy: str, chunk: int,
