@@ -86,6 +86,7 @@ def run_plan(args) -> int:
     from ray_lightning_tpu.parallel.mesh import MeshSpec
     from ray_lightning_tpu.parallel.plan import (
         dp_degree,
+        find_max_local_batch,
         llama_activation_bytes,
         plan_train_memory,
     )
@@ -95,7 +96,10 @@ def run_plan(args) -> int:
         "llama3-8b": LlamaConfig.llama3_8b,
         "tiny": LlamaConfig.tiny,
     }
-    for name in ("data", "fsdp", "tensor", "batch", "seq"):
+    # --find-max-batch ignores --batch entirely, including its validation
+    checked = ("data", "fsdp", "tensor", "seq") if args.find_max_batch \
+        else ("data", "fsdp", "tensor", "batch", "seq")
+    for name in checked:
         if getattr(args, name) < 1:
             # a zero/negative axis would ZeroDivisionError below — exit 2,
             # never a traceback colliding with the exit-1 verdict
@@ -110,7 +114,7 @@ def run_plan(args) -> int:
     n_devices = args.data * args.fsdp * args.tensor
     dp = dp_degree(MeshSpec(data=args.data, fsdp=args.fsdp,
                             tensor=args.tensor))
-    if args.batch % dp != 0:
+    if not args.find_max_batch and args.batch % dp != 0:
         # a clamped/floored local batch would produce a FITS verdict for
         # a job that cannot actually shard its batch — refuse up front
         return _plan_invalid(
@@ -120,6 +124,42 @@ def run_plan(args) -> int:
             args.as_json,
         )
     try:
+        if args.find_max_batch:
+            # auto_scale_batch_size, plan-side: search the activation
+            # bound against the HBM left after the batch-independent
+            # weight costs — no devices, no failed compiles
+            local, plan = find_max_local_batch(
+                LlamaModule(cfg),
+                ShardedMesh(data=args.data, fsdp=args.fsdp,
+                            tensor=args.tensor),
+                n_devices=n_devices,
+                example_batch={"tokens": np.zeros((dp, args.seq + 1),
+                                                  np.int32)},
+                activation_bytes_fn=lambda b: llama_activation_bytes(
+                    cfg, b, args.seq,
+                    weight_shard_degree=args.fsdp * args.tensor),
+                device_kind=args.device_kind,
+            )
+            # local==0 returns the activation-free plan, whose own
+            # summary can read FITS (the weights fit; no batch does) —
+            # label it so no consumer reads a contradiction
+            summary = plan.summary() if local >= 1 else (
+                "no local batch fits — weights-only plan: "
+                + plan.summary())
+            result = {
+                "max_local_batch": local,
+                "max_global_batch": local * dp,
+                "dp_degree": dp,
+                "fits": local >= 1,
+                "summary": summary,
+            }
+            if args.as_json:
+                print(json.dumps(result))
+            else:
+                print(f"max batch: {local}/device x dp {dp} = "
+                      f"{local * dp} global")
+                print(summary)
+            return 0 if local >= 1 else 1
         plan = plan_train_memory(
             LlamaModule(cfg),
             ShardedMesh(data=args.data, fsdp=args.fsdp, tensor=args.tensor),
@@ -172,6 +212,12 @@ def main(argv=None) -> int:
     plan_p.add_argument("--ce-inline-bwd", action="store_true",
                         help="plan with the inline-backward fused CE "
                              "(charges its dx + sharded dW residuals)")
+    plan_p.add_argument("--find-max-batch", action="store_true",
+                        help="ignore --batch and report the largest "
+                             "per-device batch (and the implied global "
+                             "batch) that fits this mesh/chip — "
+                             "auto_scale_batch_size without touching "
+                             "hardware")
     # SUPPRESS: the subparser parses into the SAME namespace the parent
     # already filled — a plain default=False here would overwrite a
     # `--json` given before the subcommand

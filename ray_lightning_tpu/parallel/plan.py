@@ -253,6 +253,68 @@ def llama_activation_bytes(cfg, local_batch: int, seq: int,
     return int(1.5 * (saved + live + ce))
 
 
+def find_max_local_batch(
+    module,
+    strategy,
+    n_devices: int,
+    example_batch: Any,
+    activation_bytes_fn,
+    *,
+    device_kind: str = "TPU v5p",
+    hbm_bytes_per_device: Optional[int] = None,
+    reserve_fraction: float = 0.10,
+    ceiling: int = 65536,
+) -> tuple[int, MemoryPlan]:
+    """Largest per-device batch that fits, found at plan time — the
+    TPU-first analog of PTL's ``auto_scale_batch_size`` (which the
+    reference inherited from its PTL base): instead of trial-and-error
+    OOM probing on live hardware, the weight-side costs are planned once
+    (params/opt/grads are batch-independent) and the analytic activation
+    bound is binary-searched against the remaining HBM. Zero devices
+    touched, zero failed compiles.
+
+    ``activation_bytes_fn(local_batch) -> int`` must be monotone
+    non-decreasing (e.g. ``lambda b: llama_activation_bytes(cfg, b, S)``).
+    ``example_batch`` sizes only the init trace; its batch dim does not
+    constrain the search.
+
+    Returns ``(local_batch, plan)`` where ``plan`` charges the found
+    batch's activations; ``(0, plan)`` with the activation-free plan when
+    even ``local_batch=1`` does not fit (the caller's model/mesh choice is
+    the problem, not the batch). The global batch is
+    ``local_batch * dp_degree(spec)``.
+    """
+    base = plan_train_memory(
+        module, strategy, n_devices, example_batch,
+        activation_bytes_per_device=0, device_kind=device_kind,
+        hbm_bytes_per_device=hbm_bytes_per_device,
+        reserve_fraction=reserve_fraction,
+    )
+    avail = base.headroom_bytes
+
+    def fits(b: int) -> bool:
+        return activation_bytes_fn(b) <= avail
+
+    if not fits(1):  # covers avail < 0: no non-negative bound fits
+        return 0, base
+
+    # exponential growth to bracket, then bisect. Invariant: fits(lo) is
+    # verified; hi is an EXCLUSIVE upper bound (failed, or past ceiling).
+    lo, hi = 1, 2
+    while hi <= ceiling and fits(hi):
+        lo, hi = hi, hi * 2
+    hi = min(hi, ceiling + 1)
+    while hi - lo > 1:
+        mid = (lo + hi) // 2
+        if fits(mid):
+            lo = mid
+        else:
+            hi = mid
+    best = dataclasses.replace(
+        base, activation_bytes_per_device=int(activation_bytes_fn(lo)))
+    return lo, best
+
+
 def dp_degree(spec: MeshSpec) -> int:
     """Batch divisor of a spec (mirrors mesh_lib.dp_axis_names for
     specs). Requires a RESOLVED spec — a -1 wildcard would silently

@@ -122,3 +122,40 @@ def test_doctor_plan_ce_inline_flag(capsys):
     assert rc == 0 and rc2 == 0
     assert b["fits"] is True
     assert b["per_device_bytes"] > a["per_device_bytes"]
+
+
+def test_doctor_plan_find_max_batch(capsys):
+    """--find-max-batch reports the largest per-device batch for the
+    mesh/chip (auto_scale_batch_size, plan-side): global = local x dp,
+    and --batch is ignored entirely — an indivisible default must not
+    trip the divisibility refusal."""
+    from ray_lightning_tpu.__main__ import main
+
+    # data=3: the default --batch 64 is NOT divisible by dp=3; the flag
+    # ignores --batch so this must still plan (rc 0/1, never 2)
+    rc = main(["plan", "--preset", "tiny", "--data", "3", "--fsdp", "1",
+               "--seq", "128", "--device-kind", "TPU v5e",
+               "--find-max-batch", "--json"])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert rc == 0, out
+    assert out["fits"] is True
+    assert out["max_local_batch"] >= 1
+    assert out["max_global_batch"] == out["max_local_batch"] * 3
+    assert out["dp_degree"] == 3
+
+
+def test_doctor_plan_find_max_batch_no_fit_labelled(capsys):
+    """local==0 returns the activation-free plan, whose own summary can
+    read FITS (the weights fit; no batch does) — the CLI must label it
+    so neither a human nor a script reads a contradiction."""
+    from ray_lightning_tpu.__main__ import main
+
+    # 8B over 64 v3 chips: ~1.9 GiB/device of weights fit easily, but
+    # one S=32768 row's activations alone overflow 16 GiB
+    rc = main(["plan", "--preset", "llama3-8b", "--fsdp", "64",
+               "--seq", "32768", "--device-kind", "TPU v3",
+               "--find-max-batch", "--json"])
+    out = json.loads(capsys.readouterr().out.strip())
+    assert rc == 1
+    assert out["fits"] is False and out["max_local_batch"] == 0
+    assert out["summary"].startswith("no local batch fits")

@@ -263,3 +263,80 @@ def test_activation_bytes_counts_inline_ce_residuals():
     b = llama_activation_bytes(inline, local_batch=1, seq=8192)
     # at least the f32 [D, V] accumulator (x1.5 slack), ~3 GB at 8B scale
     assert b - a >= 1.5 * base.dim * base.vocab_size * 4
+
+
+def test_find_max_local_batch_exact_boundary():
+    """The search returns EXACTLY the largest batch whose activation
+    bound fits the post-weights headroom — including non-power-of-2
+    optima the exponential bracket alone would miss."""
+    from ray_lightning_tpu.parallel.plan import find_max_local_batch
+
+    cfg = LlamaConfig.tiny()
+    per_batch = 64 * 1024**2  # 64 MiB per local-batch row, linear
+
+    local, plan = find_max_local_batch(
+        LlamaModule(cfg), ShardedMesh(data=8), n_devices=8,
+        example_batch=_batch_struct(8, cfg.max_seq_len),
+        activation_bytes_fn=lambda b: b * per_batch,
+        device_kind="TPU v5e",
+    )
+    assert local >= 1
+    # exactness: the found batch fits, the next one does not
+    headroom_wo_acts = plan.headroom_bytes + plan.activation_bytes_per_device
+    assert local * per_batch <= headroom_wo_acts
+    assert (local + 1) * per_batch > headroom_wo_acts
+    assert plan.activation_bytes_per_device == local * per_batch
+    assert plan.fits, plan.summary()
+    # a 16 GiB chip minus tiny-model weights leaves a non-trivial,
+    # non-power-of-2 count of 64 MiB rows — guard the bisection path
+    assert local not in (0, 1)
+
+
+def test_find_max_local_batch_no_fit_returns_zero():
+    """When even local_batch=1 exceeds the headroom the caller gets
+    (0, activation-free plan) — the model/mesh is the problem, not the
+    batch, and the summary says what the weights alone cost."""
+    from ray_lightning_tpu.parallel.plan import find_max_local_batch
+
+    cfg = LlamaConfig.tiny()
+    local, plan = find_max_local_batch(
+        LlamaModule(cfg), ShardedMesh(data=1), n_devices=1,
+        example_batch=_batch_struct(1, cfg.max_seq_len),
+        activation_bytes_fn=lambda b: 10**15,
+        device_kind="TPU v5e",
+    )
+    assert local == 0
+    assert plan.activation_bytes_per_device == 0
+
+
+def test_find_max_local_batch_ceiling_clamps():
+    """A free activation function saturates at the ceiling rather than
+    spinning the growth loop forever."""
+    from ray_lightning_tpu.parallel.plan import find_max_local_batch
+
+    cfg = LlamaConfig.tiny()
+    local, _ = find_max_local_batch(
+        LlamaModule(cfg), ShardedMesh(data=1), n_devices=1,
+        example_batch=_batch_struct(1, cfg.max_seq_len),
+        activation_bytes_fn=lambda b: 0,
+        device_kind="TPU v5p", ceiling=100,
+    )
+    assert local == 100
+
+
+def test_find_max_batch_8b_north_star():
+    """The north-star mesh (8B FSDP on v5p-64, S=8192) must admit at
+    least the BASELINE global batch of 64 (local 1) — and the finder's
+    answer must itself plan as FITS under the real flagship bound."""
+    from ray_lightning_tpu.parallel.plan import find_max_local_batch
+
+    cfg = _cfg_8b(max_seq_len=8192)
+    local, plan = find_max_local_batch(
+        LlamaModule(cfg), ShardedMesh(fsdp=64), n_devices=64,
+        example_batch=_batch_struct(64, 8192),
+        activation_bytes_fn=lambda b: llama_activation_bytes(
+            cfg, b, 8192, weight_shard_degree=64),
+        device_kind="TPU v5p",
+    )
+    assert local >= 1, plan.summary()
+    assert plan.fits, plan.summary()
