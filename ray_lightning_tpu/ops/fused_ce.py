@@ -25,12 +25,24 @@ reduction order.
 """
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+def _inline_unroll_max() -> int:
+    """Chunk-count ceiling for unrolling the inline-CE forward (above it,
+    fall back to lax.scan). Parse-or-default on the env override — a
+    malformed value must degrade, not fail the training step at trace
+    time (the same policy as the flash block-size knobs)."""
+    try:
+        return int(os.environ.get("RLT_CE_INLINE_UNROLL_MAX", 16))
+    except ValueError:
+        return 16
 
 
 def fused_cross_entropy(
@@ -168,22 +180,56 @@ def _ce_inline_fwd(chunk_tokens, dtype_name, hidden, lm_head, targets, m):
         tgt = jnp.take_along_axis(logits, t_c[:, None], axis=-1)[:, 0]
         loss_c = ((lse - tgt) * m_c).sum()
         # d(mean CE)/d(logits) = (softmax - onehot) * m/Σm — computed
-        # here, once, from the tile that is already live
+        # here, once, from the tile that is already live. The onehot is a
+        # broadcasted-iota compare, NOT a scatter: elementwise, so XLA
+        # fuses exp + subtract + scale + cast into one pass over the tile
+        # and the only materialized [C, V] intermediates are the f32
+        # logits and the bf16 dlogits (a scatter would force a second
+        # f32 [C, V] buffer — the peak-memory cliff that kept the larger
+        # inline batches from compiling on a 16 GB chip).
         coeff = m_c * inv
-        dlogits = jnp.exp(logits - lse[:, None]) * coeff[:, None]
-        dlogits = dlogits.at[jnp.arange(dlogits.shape[0]), t_c].add(-coeff)
-        dlogits = dlogits.astype(compute_dtype)
+        onehot = (
+            jax.lax.broadcasted_iota(t_c.dtype, logits.shape, 1)
+            == t_c[:, None]
+        )
+        dlogits = (
+            (jnp.exp(logits - lse[:, None]) - onehot) * coeff[:, None]
+        ).astype(compute_dtype)
         dx_c = jnp.dot(dlogits, w.T, preferred_element_type=jnp.float32)
         dw_acc = dw_acc + jnp.dot(x_c.T, dlogits,
                                   preferred_element_type=jnp.float32)
         return dw_acc, (loss_c, dx_c.astype(hidden.dtype))
 
-    dw, (loss_chunks, dx) = jax.lax.scan(
-        body,
-        jnp.zeros((D, V), jnp.float32),
-        (x.reshape(n_chunks, C, D), t.reshape(n_chunks, C),
-         mm.reshape(n_chunks, C)),
-    )
+    xs = (x.reshape(n_chunks, C, D), t.reshape(n_chunks, C),
+          mm.reshape(n_chunks, C))
+    if n_chunks <= _inline_unroll_max():
+        # Straight-line chunk chain instead of a `while` loop: n_chunks is
+        # static, and a lax.scan whose CARRY is the [D, V] f32 dW
+        # accumulator (~1 GB at Llama-3 vocab) is the program shape the
+        # TPU compile path handled worst in our sweeps (observed on v5e:
+        # minutes-long or helper-crashing compiles at n_chunks >= 2,
+        # scripts/sweep_flagship_results.jsonl); unrolling removes the
+        # while-loop + giant-carry structure entirely. The
+        # optimization_barrier threads each chunk's inputs through the
+        # previous chunk's dW so the bodies form a data-dependence CHAIN:
+        # without it only the dw adds are ordered and the scheduler may
+        # overlap several [C, V] logits tiles, silently breaking the
+        # O(C·V) live-logits bound this module exists to provide (and
+        # that parallel/plan.py charges for exactly once).
+        dw = jnp.zeros((D, V), jnp.float32)
+        loss_parts, dx_parts = [], []
+        for i in range(n_chunks):
+            inp = jax.tree.map(lambda a: a[i], xs)
+            if i:
+                inp, dw = jax.lax.optimization_barrier((inp, dw))
+            dw, (loss_c, dx_c) = body(dw, inp)
+            loss_parts.append(loss_c)
+            dx_parts.append(dx_c)
+        loss_chunks = jnp.stack(loss_parts)
+        dx = jnp.stack(dx_parts)
+    else:
+        dw, (loss_chunks, dx) = jax.lax.scan(
+            body, jnp.zeros((D, V), jnp.float32), xs)
     loss = loss_chunks.sum() * inv
     dx_full = dx.reshape(T + pad, D)[:T].reshape(B, S, D)
     # residuals must be arrays only (shapes/dtypes are recovered from dx

@@ -248,7 +248,11 @@ def llama_activation_bytes(cfg, local_batch: int, seq: int,
     ce = (cfg.ce_chunk_tokens * cfg.vocab_size * 2 * 2
           + bs * cfg.dim * (2 + 4))
     if getattr(cfg, "ce_inline_bwd", False):
-        ce += (bs * cfg.dim * 2
+        # + the live-tile delta: the inline body holds the f32 logits AND
+        # the bf16 dlogits (6 B/elem, ops/fused_ce.py _ce_inline_fwd)
+        # where the remat path's charge above assumed two bf16 tiles
+        ce += (cfg.ce_chunk_tokens * cfg.vocab_size * 2
+               + bs * cfg.dim * 2
                + cfg.dim * cfg.vocab_size * 4 // max(1, weight_shard_degree))
     return int(1.5 * (saved + live + ce))
 

@@ -143,6 +143,39 @@ class TestInlineBackward:
             np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                        atol=1e-5)
 
+    def test_unrolled_and_scan_branches_agree(self, monkeypatch):
+        """The inline forward unrolls the chunk chain when n_chunks <=
+        RLT_CE_INLINE_UNROLL_MAX and falls back to lax.scan above it —
+        the two lowerings must produce the same loss and grads (the
+        unroll exists purely to sidestep the TPU compiler's pathological
+        handling of a scan whose carry is the [D, V] dW accumulator)."""
+        hidden, w, targets, mask = _setup()
+
+        def loss(h, w):
+            # chunk_tokens=16 over T=2*32 tokens -> n_chunks=4
+            return fused_cross_entropy(h, w, targets, mask,
+                                       chunk_tokens=16,
+                                       compute_dtype=jnp.float32,
+                                       inline_backward=True)
+
+        # pin the ceiling explicitly: an ambient override <= 3 would send
+        # BOTH calls down the scan branch and the test would pass
+        # vacuously
+        monkeypatch.setenv("RLT_CE_INLINE_UNROLL_MAX", "16")
+        l_u, g_u = jax.value_and_grad(loss, argnums=(0, 1))(hidden, w)
+        monkeypatch.setenv("RLT_CE_INLINE_UNROLL_MAX", "1")
+        l_s, g_s = jax.value_and_grad(loss, argnums=(0, 1))(hidden, w)
+        np.testing.assert_allclose(np.asarray(l_u), np.asarray(l_s),
+                                   rtol=1e-6)
+        for a, b in zip(g_u, g_s):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       atol=1e-6)
+        # malformed env falls back to the default ceiling, not a crash
+        monkeypatch.setenv("RLT_CE_INLINE_UNROLL_MAX", "not-an-int")
+        l_m, _ = jax.value_and_grad(loss, argnums=(0, 1))(hidden, w)
+        np.testing.assert_allclose(np.asarray(l_m), np.asarray(l_u),
+                                   rtol=1e-6)
+
     def test_cotangent_scaling_exact(self):
         """The residuals are computed for g=1 and SCALED in bwd — a
         non-unit upstream cotangent (loss used inside a larger graph,
