@@ -169,10 +169,14 @@ def _backend_with_retry(tries: int | None = None,
     never as a raw traceback."""
     import jax
 
+    # ~10 min of total backoff by default (20+40+...+320s): the observed
+    # tunnel outages are minutes-long flaps, and the watchdog (45 min)
+    # still bounds the whole bench — a wider envelope costs nothing on a
+    # healthy chip and saves the round on a flapping one.
     if tries is None:
-        tries = max(1, int(_env_float("RLT_BENCH_INIT_RETRIES", 4)))
+        tries = max(1, int(_env_float("RLT_BENCH_INIT_RETRIES", 6)))
     if base_backoff is None:
-        base_backoff = _env_float("RLT_BENCH_INIT_BACKOFF_S", 15.0)
+        base_backoff = _env_float("RLT_BENCH_INIT_BACKOFF_S", 20.0)
     last: Exception | None = None
     for i in range(tries):
         try:
@@ -445,33 +449,56 @@ def _run() -> dict:
         # MFU counts useful FLOPs only: the backward recompute remat
         # performs is real work the flagship deliberately trades for
         # memory, so its MFU reads lower than the unrolled legs.
-        t, c = _measure(use_flash=True, fused_ce=True, batch=8, seq=2048,
-                        vocab=128256, remat=True, scan=True,
-                        remat_policy="nothing", ce_chunk_tokens=4096,
-                        ce_inline=True)
-        m = t * _flops_per_token(c, 2048) / (peak_tflops * 1e12)
+        # The inline compile has a fallback: this leg's job is a
+        # driver-verified flagship number, and an inline-path compile
+        # failure (the TPU compile helper has rejected some large inline
+        # programs — sweep JSONL) must degrade to the proven non-inline
+        # optimum rather than void the row. The fallback REUSES the
+        # rematce leg's measurement (same config; that leg runs first)
+        # instead of compiling it a second time.
+        try:
+            t, c = _measure(use_flash=True, fused_ce=True, batch=8,
+                            seq=2048, vocab=128256, remat=True, scan=True,
+                            remat_policy="nothing", ce_chunk_tokens=4096,
+                            ce_inline=True)
+            config = ("remat(nothing)+scan+fusedCE(inline) "
+                      "B=8 S=2048 V=128256 chunk=4096")
+            note = {}
+            m = t * _flops_per_token(c, 2048) / (peak_tflops * 1e12)
+        except Exception as exc:  # noqa: BLE001 — fall back, keep cause
+            note = {"flagship_inline_error":
+                    f"{type(exc).__name__}: {str(exc)[:200]}"}
+            if "rematce" not in shared:
+                raise  # no reusable measurement — surface the real error
+            t, m = shared["rematce"]
+            config = ("remat(nothing)+scan+fusedCE(remat) "
+                      "B=8 S=2048 V=128256 chunk=4096 [inline fallback: "
+                      "rematce leg's measurement]")
         mfus.append(m)
         return {"flagship_tokens_per_sec": round(t, 1),
                 "flagship_mfu": round(m, 4),
-                "flagship_config": "remat(nothing)+scan+fusedCE(inline) "
-                                   "B=8 S=2048 V=128256 chunk=4096"}
+                "flagship_config": config, **note}
+
+    shared: dict = {}
 
     def _flagship_remat_ce():
         # the pre-inline flagship config, kept as its own leg so the
-        # inline win is visible in one artifact
+        # inline win is visible in one artifact; runs BEFORE the inline
+        # leg so the latter's fallback can reuse this measurement
         t, c = _measure(use_flash=True, fused_ce=True, batch=8, seq=2048,
                         vocab=128256, remat=True, scan=True,
                         remat_policy="nothing", ce_chunk_tokens=4096)
         m = t * _flops_per_token(c, 2048) / (peak_tflops * 1e12)
         mfus.append(m)
+        shared["rematce"] = (t, m)
         return {"flagship_rematce_tokens_per_sec": round(t, 1),
                 "flagship_rematce_mfu": round(m, 4)}
 
     leg("vs_baseline", _baseline)
     leg("s4096", _s4k)
     leg("v128k", _v128k)
-    leg("flagship", _flagship)
     leg("flagship_rematce", _flagship_remat_ce)
+    leg("flagship", _flagship)
 
     # Self-consistency (VERDICT r3 weak #1): the probe is a THROUGHPUT
     # ceiling; any model leg reading more effective FLOP/s than the bare

@@ -37,11 +37,21 @@ import numpy as np
 def _inline_unroll_max() -> int:
     """Chunk-count ceiling for unrolling the inline-CE forward (above it,
     fall back to lax.scan). Parse-or-default on the env override — a
-    malformed value must degrade, not fail the training step at trace
-    time (the same policy as the flash block-size knobs)."""
+    malformed value must degrade (with a warning, so a mistyped override
+    is debuggable), not fail the training step at trace time — the same
+    policy as the flash block-size knobs (ops/pallas/flash.py
+    _env_block)."""
+    raw = os.environ.get("RLT_CE_INLINE_UNROLL_MAX")
+    if raw is None:
+        return 16
     try:
-        return int(os.environ.get("RLT_CE_INLINE_UNROLL_MAX", 16))
+        return int(raw)
     except ValueError:
+        import warnings
+
+        warnings.warn(
+            f"RLT_CE_INLINE_UNROLL_MAX={raw!r} is not an int; "
+            "using default 16", stacklevel=2)
         return 16
 
 
@@ -221,7 +231,15 @@ def _ce_inline_fwd(chunk_tokens, dtype_name, hidden, lm_head, targets, m):
         for i in range(n_chunks):
             inp = jax.tree.map(lambda a: a[i], xs)
             if i:
-                inp, dw = jax.lax.optimization_barrier((inp, dw))
+                # ALL of the previous chunk's outputs go through the
+                # barrier, not just dw: dx_c consumes the dlogits tile,
+                # and leaving it outside the chain would let the
+                # scheduler defer every dx matmul to the end — n_chunks
+                # dlogits tiles live at once, the exact blow-up the
+                # barrier exists to forbid.
+                inp, dw, loss_parts[-1], dx_parts[-1] = (
+                    jax.lax.optimization_barrier(
+                        (inp, dw, loss_parts[-1], dx_parts[-1])))
             dw, (loss_c, dx_c) = body(dw, inp)
             loss_parts.append(loss_c)
             dx_parts.append(dx_c)
