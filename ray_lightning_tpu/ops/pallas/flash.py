@@ -355,14 +355,32 @@ def _flash_bhsd(q, k, v, scale, causal, q_offset, block_q, block_k):
 
 def _flash_fwd_rule(q, k, v, scale, causal, q_offset, block_q, block_k):
     o, lse = _fwd(q, k, v, scale, causal, q_offset, block_q, block_k)
-    return o, (q, k, v, o, lse)
+    # Label the VJP residuals for jaxpr readability. NOTE these names
+    # alone cannot make a remat policy save the residuals — a custom_vjp
+    # fwd rule is not part of the primal trace, so a named-saveable
+    # policy sees nothing (verified in tests/test_ops.py). The working
+    # mechanism for remat_policy="attn_out" is optimize_remat=True below,
+    # which hoists this rule into a `remat_opt` call whose outputs the
+    # policy saves (models/llama.py _attn_residuals_saveable).
+    from jax.ad_checkpoint import checkpoint_name
+
+    res = tuple(checkpoint_name(t, "flash_residuals")
+                for t in (q, k, v, o, lse))
+    return o, res
 
 
 def _flash_bwd_rule(scale, causal, q_offset, block_q, block_k, res, do):
     return _bwd(scale, causal, q_offset, block_q, block_k, res, do)
 
 
-_flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+# optimize_remat: without it a custom_vjp is OPAQUE to remat policies —
+# the residuals live only in the fwd rule, which is not part of the
+# primal trace, so save_only_these_names("flash_residuals") had nothing
+# to save and the kernel forward re-ran in every remat backward (counted
+# via pallas_call occurrences in the jaxpr, tests/test_ops.py). With it,
+# JAX rewrites the call so the fwd rule's residual outputs are visible
+# to the surrounding checkpoint and the policy decides their fate.
+_flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule, optimize_remat=True)
 
 
 def _env_block(name: str, default: int) -> int:

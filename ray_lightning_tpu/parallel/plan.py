@@ -240,6 +240,13 @@ def llama_activation_bytes(cfg, local_batch: int, seq: int,
     bs = local_batch * seq
     hd = cfg.head_dim
     saved = cfg.n_layers * bs * cfg.dim * 2
+    if getattr(cfg, "remat_policy", "nothing") == "attn_out":
+        # per-layer saved attention residuals (q, o: H·hd; k, v: Hkv·hd;
+        # model dtype) + the f32 logsumexp — models/llama.py
+        # _attn_residuals_saveable
+        saved += cfg.n_layers * bs * (
+            (2 * cfg.n_heads + 2 * cfg.n_kv_heads) * hd * 2
+            + cfg.n_heads * 4)
     live = bs * (
         2 * cfg.dim
         + (cfg.n_heads + 2 * cfg.n_kv_heads) * hd

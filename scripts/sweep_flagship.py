@@ -12,7 +12,8 @@ Appends one JSON line per config to scripts/sweep_flagship_results.jsonl
 so a partial sweep is still a usable record.
 
 Usage: python scripts/sweep_flagship.py [phase]
-  phase in {1,2,3,4,all,retry} — 4 sweeps the inline-backward fused CE;
+  phase in {1,2,3,4,5,all,retry} — 4 sweeps the inline-backward fused
+  CE; 5 sweeps remat_policy="attn_out" (saved flash residuals);
   "retry" re-runs the points that died on transient remote-compile 500s.
 """
 from __future__ import annotations
@@ -129,6 +130,16 @@ def main():
             for chunk in (2048, 8192, 16384):
                 run_one(f"p4-inline-chunk{chunk}", batch=bi["batch"],
                         policy=bi["policy"], chunk=chunk, inline=True)
+    if phase in ("5", "all"):
+        # remat_policy="attn_out" (save flash VJP residuals, skip the
+        # attention share of the backward recompute — VERDICT r4 next #2's
+        # "remat policies that save attention outputs"), with and without
+        # the inline CE, around the incumbent batch/chunk
+        for batch in (4, 8):
+            for inline in (False, True):
+                tag = f"p5-attnout-b{batch}" + ("-inline" if inline else "")
+                run_one(tag, batch=batch, policy="attn_out", chunk=4096,
+                        inline=inline)
     if phase == "retry":
         # re-run the points that died on transient remote-compile HTTP
         # 500s (VERDICT r4 weak #2) — unknowns, not losers

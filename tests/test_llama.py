@@ -78,8 +78,8 @@ class TestLlamaForward:
 
     @pytest.mark.slow  # 3 full forward compiles of the same model
     def test_remat_policies_equivalent(self):
-        """remat off / full / dots-saveable are schedule choices, not math:
-        losses and grads must agree."""
+        """remat off / full / dots-saveable / attn_out-saveable are
+        schedule choices, not math: losses and grads must agree."""
         import jax.numpy as jnp
 
         from ray_lightning_tpu.models.llama import LlamaModule
@@ -88,7 +88,7 @@ class TestLlamaForward:
                              % 64)}
         outs = []
         for remat, policy in ((False, "nothing"), (True, "nothing"),
-                              (True, "dots")):
+                              (True, "dots"), (True, "attn_out")):
             cfg = LlamaConfig(
                 vocab_size=64, dim=32, n_layers=2, n_heads=2, n_kv_heads=1,
                 hidden_dim=64, max_seq_len=64, use_flash=False,
