@@ -83,7 +83,7 @@ def _flops_per_token(cfg, seq: int) -> float:
 def _make_step(use_flash: bool, fused_ce: bool, batch: int, seq: int,
                vocab: int = 32768, remat: bool = True, scan: bool = True,
                remat_policy: str = "nothing", ce_chunk_tokens: int = 2048,
-               ce_inline: bool = False):
+               ce_inline: bool = False, mu_dtype=None):
     import jax
     import optax
 
@@ -98,7 +98,8 @@ def _make_step(use_flash: bool, fused_ce: bool, batch: int, seq: int,
         jax.random.key(0), (batch, seq + 1), 0, cfg.vocab_size, dtype=np.int32
     )
     params = jax.jit(model.init)(jax.random.key(0), tokens[:, :-1])["params"]
-    tx = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
+    tx = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1,
+                     mu_dtype=mu_dtype)
     opt_state = jax.jit(tx.init)(params)
 
     def loss_fn(params, tokens):
@@ -141,10 +142,10 @@ def _time_step(step, params, opt_state, tokens, warmup=3, iters=5,
 def _measure(use_flash: bool, fused_ce: bool, batch: int, seq: int,
              vocab: int = 32768, remat: bool = True, scan: bool = True,
              remat_policy: str = "nothing", ce_chunk_tokens: int = 2048,
-             ce_inline: bool = False):
+             ce_inline: bool = False, mu_dtype=None):
     step, params, opt_state, tokens, tps, cfg = _make_step(
         use_flash, fused_ce, batch, seq, vocab, remat, scan,
-        remat_policy, ce_chunk_tokens, ce_inline
+        remat_policy, ce_chunk_tokens, ce_inline, mu_dtype
     )
     dt = _time_step(step, params, opt_state, tokens)
     del step, params, opt_state, tokens

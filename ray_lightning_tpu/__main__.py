@@ -111,6 +111,12 @@ def run_plan(args) -> int:
         remat=True, scan_layers=True, fused_ce=True, max_seq_len=args.seq,
         ce_inline_bwd=args.ce_inline_bwd,
     )
+
+    def _module():
+        import jax.numpy as jnp
+
+        return LlamaModule(
+            cfg, mu_dtype=jnp.bfloat16 if args.mu_bf16 else None)
     n_devices = args.data * args.fsdp * args.tensor
     dp = dp_degree(MeshSpec(data=args.data, fsdp=args.fsdp,
                             tensor=args.tensor))
@@ -129,7 +135,7 @@ def run_plan(args) -> int:
             # bound against the HBM left after the batch-independent
             # weight costs — no devices, no failed compiles
             local, plan = find_max_local_batch(
-                LlamaModule(cfg),
+                _module(),
                 ShardedMesh(data=args.data, fsdp=args.fsdp,
                             tensor=args.tensor),
                 n_devices=n_devices,
@@ -161,7 +167,7 @@ def run_plan(args) -> int:
                 print(summary)
             return 0 if local >= 1 else 1
         plan = plan_train_memory(
-            LlamaModule(cfg),
+            _module(),
             ShardedMesh(data=args.data, fsdp=args.fsdp, tensor=args.tensor),
             n_devices=n_devices,
             example_batch={"tokens": np.zeros((args.batch, args.seq + 1),
@@ -212,6 +218,10 @@ def main(argv=None) -> int:
     plan_p.add_argument("--ce-inline-bwd", action="store_true",
                         help="plan with the inline-backward fused CE "
                              "(charges its dx + sharded dW residuals)")
+    plan_p.add_argument("--mu-bf16", action="store_true",
+                        help="plan with a bf16 Adam first moment "
+                             "(mu_dtype=bfloat16 — halves the mu buffer; "
+                             "the planner charges the real dtype)")
     plan_p.add_argument("--find-max-batch", action="store_true",
                         help="ignore --batch and report the largest "
                              "per-device batch (and the implied global "

@@ -501,7 +501,14 @@ class LlamaModule(TpuModule):
     def __init__(self, cfg: Optional[LlamaConfig] = None,
                  lr: float = 3e-4, weight_decay: float = 0.1,
                  warmup_steps: int = 100, total_steps: int = 10000,
+                 mu_dtype: Optional[Any] = None,
                  **cfg_overrides):
+        """``mu_dtype``: storage dtype for Adam's first moment (e.g.
+        ``jnp.bfloat16``; default None = the params' f32). Halves the
+        mu buffer — ~1/4 of optimizer HBM — which on a memory-capped
+        chip buys batch instead; the variance (nu) always stays f32.
+        The planner charges the real dtype automatically (it eval_shapes
+        this optimizer), as do checkpoints (orbax saves the tree as-is)."""
         super().__init__()
         if cfg is None:
             cfg = LlamaConfig(**cfg_overrides)
@@ -512,9 +519,11 @@ class LlamaModule(TpuModule):
         self.weight_decay = weight_decay
         self.warmup_steps = warmup_steps
         self.total_steps = total_steps
+        self.mu_dtype = mu_dtype
         self.save_hyperparameters(
             cfg=cfg, lr=lr, weight_decay=weight_decay,
             warmup_steps=warmup_steps, total_steps=total_steps,
+            mu_dtype=mu_dtype,
         )
 
     def configure_model(self):
@@ -528,7 +537,8 @@ class LlamaModule(TpuModule):
             end_value=self.lr * 0.1,
         )
         return optax.adamw(sched, b1=0.9, b2=0.95,
-                           weight_decay=self.weight_decay)
+                           weight_decay=self.weight_decay,
+                           mu_dtype=self.mu_dtype)
 
     def param_specs(self, params) -> Dict[str, P]:
         return llama_param_specs(self.cfg)

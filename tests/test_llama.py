@@ -141,6 +141,51 @@ class TestLlamaTraining:
         # "below some constant" (chance level for vocab 256 is ln(256)≈5.55).
         assert final < first.value - 0.2, (first.value, final)
 
+    def test_mu_dtype_bf16_trains_and_halves_mu(self):
+        """mu_dtype=bfloat16: the Adam first moment is stored bf16 (the
+        memory lever that buys batch on a capped chip), nu stays f32,
+        and training still decreases the loss."""
+        import jax.numpy as jnp
+        import optax
+
+        from ray_lightning_tpu import Callback
+
+        class FirstLoss(Callback):
+            value = None
+
+            def on_train_batch_end(self, trainer, module, metrics,
+                                   batch_idx):
+                if self.value is None and "loss" in metrics:
+                    self.value = float(metrics["loss"])
+
+        first = FirstLoss()
+        cfg = LlamaConfig.tiny(use_flash=False)
+        module = LlamaModule(cfg, lr=1e-3, warmup_steps=1, total_steps=50,
+                             mu_dtype=jnp.bfloat16)
+        data = _data(cfg)
+        trainer = Trainer(strategy=DataParallel(num_workers=4),
+                          max_epochs=2, enable_progress_bar=False,
+                          enable_checkpointing=False, callbacks=[first],
+                          log_every_n_steps=1)
+        trainer.fit(module, DataLoader(data, batch_size=16, shuffle=True),
+                    DataLoader(data, batch_size=16))
+        adam = [s for s in jax.tree.leaves(
+            trainer.state.opt_state,
+            is_leaf=lambda s: isinstance(s, optax.ScaleByAdamState))
+            if isinstance(s, optax.ScaleByAdamState)]
+        assert adam, "no ScaleByAdamState found in opt_state"
+        for s in adam:
+            assert all(m.dtype == jnp.bfloat16
+                       for m in jax.tree.leaves(s.mu))
+            assert all(n.dtype == jnp.float32
+                       for n in jax.tree.leaves(s.nu))
+        # a genuine decrease from the recorded step-1 loss (the adjacent
+        # dp test's discipline): the bf16 moment must not stop learning,
+        # not merely avoid divergence
+        final = float(trainer.callback_metrics["val_loss"])
+        assert first.value is not None and final < first.value - 0.2, (
+            first.value, final)
+
     def test_fsdp_sharding_applied(self, devices8):
         trainer, module = _fit(FSDP(min_shard_size=1))
         leaf = module.params["layers"]["w_gate_up"]["kernel"]
