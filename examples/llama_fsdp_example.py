@@ -51,8 +51,11 @@ def main():
     p.add_argument("--no-remat", action="store_true",
                    help="disable rematerialization (more HBM, no "
                         "backward recompute)")
-    p.add_argument("--remat-policy", choices=["nothing", "dots"],
-                   default="nothing")
+    p.add_argument("--remat-policy",
+                   choices=["nothing", "dots", "attn_out"],
+                   default="nothing",
+                   help="what the per-layer checkpoint saves: nothing / "
+                        "all matmul outputs / the attention residuals")
     p.add_argument("--no-scan-layers", action="store_true",
                    help="unroll the layer stack (free schedule; pair "
                         "with --no-remat)")
@@ -62,6 +65,9 @@ def main():
     p.add_argument("--ce-inline-bwd", action="store_true",
                    help="compute CE grads inline in the forward scan "
                         "(no logits-tile recompute; +D x V residual)")
+    p.add_argument("--mu-bf16", action="store_true",
+                   help="store Adam's first moment in bf16 (-25%% "
+                        "optimizer HBM; buys batch on capped chips)")
     p.add_argument("--smoke-test", action="store_true")
     args = p.parse_args()
 
@@ -118,9 +124,12 @@ def main():
                            pipe=args.pipe)
 
     seq_len = min(args.seq_len, cfg.max_seq_len)
+    import jax.numpy as jnp
+
     module = LlamaModule(cfg, lr=args.lr,
                          warmup_steps=min(10, max(1, args.max_steps // 2)),
-                         total_steps=args.max_steps)
+                         total_steps=args.max_steps,
+                         mu_dtype=jnp.bfloat16 if args.mu_bf16 else None)
     data = synthetic_tokens(
         cfg.vocab_size,
         n_seqs=max(64, 4 * args.batch_size),
