@@ -152,6 +152,37 @@ def _measure(use_flash: bool, fused_ce: bool, batch: int, seq: int,
     return tps / dt, cfg
 
 
+def _flagship_leg(measure, shared: dict, mfu_of, shape_desc: str):
+    """The flagship leg's measurement policy, extracted for unit tests
+    (tests/test_bench.py): try the inline-CE config; on a compile
+    rejection reuse the rematce leg's measurement from ``shared``
+    (identical configuration, already timed — never compile it twice),
+    preserving the inline failure cause; with nothing to reuse,
+    re-raise so leg() degrades the row with the REAL error.
+
+    ``measure(ce_inline=...)`` -> (tokens_per_sec, cfg); ``mfu_of(t, c)``
+    -> useful-FLOP MFU; ``shape_desc`` describes the measured shape and
+    lives WITH the measure closure so the artifact's config string
+    cannot drift from the actual parameters. Returns ``(row, mfu)``.
+    """
+    try:
+        t, c = measure(ce_inline=True)
+        config = f"remat(nothing)+scan+fusedCE(inline) {shape_desc}"
+        note = {}
+        m = mfu_of(t, c)
+    except Exception as exc:  # noqa: BLE001 — fall back, keep cause
+        note = {"flagship_inline_error":
+                f"{type(exc).__name__}: {str(exc)[:200]}"}
+        if "rematce" not in shared:
+            raise  # no reusable measurement — surface the real error
+        t, m = shared["rematce"]
+        config = (f"remat(nothing)+scan+fusedCE(remat) {shape_desc} "
+                  "[inline fallback: rematce leg's measurement]")
+    return ({"flagship_tokens_per_sec": round(t, 1),
+             "flagship_mfu": round(m, 4),
+             "flagship_config": config, **note}, m)
+
+
 def _env_float(name: str, default: float) -> float:
     try:
         return float(os.environ.get(name, default))
@@ -454,31 +485,19 @@ def _run() -> dict:
         # driver-verified flagship number, and an inline-path compile
         # failure (the TPU compile helper has rejected some large inline
         # programs — sweep JSONL) must degrade to the proven non-inline
-        # optimum rather than void the row. The fallback REUSES the
-        # rematce leg's measurement (same config; that leg runs first)
-        # instead of compiling it a second time.
-        try:
-            t, c = _measure(use_flash=True, fused_ce=True, batch=8,
+        # optimum rather than void the row (_flagship_leg).
+        def measure(ce_inline):
+            return _measure(use_flash=True, fused_ce=True, batch=8,
                             seq=2048, vocab=128256, remat=True, scan=True,
                             remat_policy="nothing", ce_chunk_tokens=4096,
-                            ce_inline=True)
-            config = ("remat(nothing)+scan+fusedCE(inline) "
-                      "B=8 S=2048 V=128256 chunk=4096")
-            note = {}
-            m = t * _flops_per_token(c, 2048) / (peak_tflops * 1e12)
-        except Exception as exc:  # noqa: BLE001 — fall back, keep cause
-            note = {"flagship_inline_error":
-                    f"{type(exc).__name__}: {str(exc)[:200]}"}
-            if "rematce" not in shared:
-                raise  # no reusable measurement — surface the real error
-            t, m = shared["rematce"]
-            config = ("remat(nothing)+scan+fusedCE(remat) "
-                      "B=8 S=2048 V=128256 chunk=4096 [inline fallback: "
-                      "rematce leg's measurement]")
+                            ce_inline=ce_inline)
+
+        row, m = _flagship_leg(
+            measure, shared,
+            lambda t, c: t * _flops_per_token(c, 2048) / (peak_tflops * 1e12),
+            shape_desc="B=8 S=2048 V=128256 chunk=4096")
         mfus.append(m)
-        return {"flagship_tokens_per_sec": round(t, 1),
-                "flagship_mfu": round(m, 4),
-                "flagship_config": config, **note}
+        return row
 
     shared: dict = {}
 

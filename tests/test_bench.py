@@ -181,3 +181,45 @@ def test_watchdog_fires_on_hang():
     obj = json.loads(p.stdout.strip().splitlines()[-1])
     assert "did not complete" in obj["error"]
     assert obj["value"] == 0.0
+
+
+def test_flagship_leg_inline_fallback_reuses_rematce():
+    """The flagship leg's documented degradation ladder: inline compile
+    rejected -> reuse the rematce measurement (same config, no second
+    compile) with the failure cause preserved; nothing to reuse ->
+    re-raise so the row degrades with the REAL error."""
+    import bench
+
+    class Cfg:  # _flops_per_token stand-in not needed: mfu_of is injected
+        pass
+
+    calls = []
+
+    def ok_measure(ce_inline):
+        calls.append(ce_inline)
+        return 1000.0, Cfg()
+
+    row, m = bench._flagship_leg(ok_measure, {"rematce": (900.0, 0.4)},
+                                 lambda t, c: 0.5, "B=8 test-shape")
+    assert row["flagship_tokens_per_sec"] == 1000.0
+    assert m == 0.5
+    assert "B=8 test-shape" in row["flagship_config"]
+    assert "inline" in row["flagship_config"]
+    assert "flagship_inline_error" not in row
+    assert calls == [True]  # the rematce measurement was NOT re-run
+
+    def failing_measure(ce_inline):
+        raise RuntimeError("remote_compile HTTP 500")
+
+    row, m = bench._flagship_leg(failing_measure, {"rematce": (900.0, 0.4)},
+                                 lambda t, c: 0.5, "B=8 test-shape")
+    assert row["flagship_tokens_per_sec"] == 900.0
+    assert m == 0.4
+    assert "fallback" in row["flagship_config"]
+    assert "HTTP 500" in row["flagship_inline_error"]
+
+    import pytest
+
+    with pytest.raises(RuntimeError, match="HTTP 500"):
+        bench._flagship_leg(failing_measure, {}, lambda t, c: 0.5,
+                            "B=8 test-shape")
