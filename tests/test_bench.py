@@ -188,8 +188,6 @@ def test_flagship_leg_inline_fallback_reuses_rematce():
     rejected -> reuse the rematce measurement (same config, no second
     compile) with the failure cause preserved; nothing to reuse ->
     re-raise so the row degrades with the REAL error."""
-    import bench
-
     class Cfg:  # _flops_per_token stand-in not needed: mfu_of is injected
         pass
 
@@ -217,8 +215,6 @@ def test_flagship_leg_inline_fallback_reuses_rematce():
     assert m == 0.4
     assert "fallback" in row["flagship_config"]
     assert "HTTP 500" in row["flagship_inline_error"]
-
-    import pytest
 
     with pytest.raises(RuntimeError, match="HTTP 500"):
         bench._flagship_leg(failing_measure, {}, lambda t, c: 0.5,

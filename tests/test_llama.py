@@ -82,8 +82,6 @@ class TestLlamaForward:
         schedule choices, not math: losses and grads must agree."""
         import jax.numpy as jnp
 
-        from ray_lightning_tpu.models.llama import LlamaModule
-
         tokens = {"tokens": (np.arange(34, dtype=np.int32).reshape(2, 17)
                              % 64)}
         outs = []
@@ -140,6 +138,40 @@ class TestLlamaTraining:
         # A genuine decrease from the recorded step-1 loss — not just
         # "below some constant" (chance level for vocab 256 is ln(256)≈5.55).
         assert final < first.value - 0.2, (first.value, final)
+
+    @pytest.mark.slow  # two full compiles with the interpret-mode kernel
+    def test_remat_attn_out_with_pallas_flash(self, monkeypatch):
+        """The production combination — scanned layers + nn.remat with
+        remat_policy='attn_out' + the pallas flash kernel (whose
+        custom_vjp is defined with optimize_remat=True, the mechanism
+        the policy saves through) — must match the no-remat gradients.
+        RLT_PALLAS=1 runs the real kernel in interpret mode on CPU;
+        shapes sized to pass the kernel's tiling gate (head_dim 64,
+        S multiple of 128)."""
+        import jax.numpy as jnp
+
+        monkeypatch.setenv("RLT_PALLAS", "1")
+        tokens = {"tokens": (np.arange(2 * 129, dtype=np.int32)
+                             .reshape(2, 129) % 64)}
+        outs = []
+        for remat in (False, True):
+            cfg = LlamaConfig(
+                vocab_size=64, dim=256, n_layers=2, n_heads=4,
+                n_kv_heads=2, hidden_dim=128, max_seq_len=128,
+                use_flash=True, dtype=jnp.float32, remat=remat,
+                remat_policy="attn_out" if remat else "nothing")
+            m = LlamaModule(cfg)
+            m.setup()
+            params = m.init_params(jax.random.key(0), tokens)
+            i, t, msk = m._split(tokens)
+            loss, grads = jax.value_and_grad(
+                lambda p: m._loss(p, i, t, msk))(params)
+            outs.append((np.asarray(loss), grads))
+        np.testing.assert_allclose(outs[1][0], outs[0][0], rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(outs[1][1]),
+                        jax.tree.leaves(outs[0][1])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=2e-5)
 
     def test_mu_dtype_bf16_trains_and_halves_mu(self):
         """mu_dtype=bfloat16: the Adam first moment is stored bf16 (the
