@@ -12,9 +12,10 @@ Appends one JSON line per config to scripts/sweep_flagship_results.jsonl
 so a partial sweep is still a usable record.
 
 Usage: python scripts/sweep_flagship.py [phase]
-  phase in {1,2,3,4,5,6,all,retry} — 4 sweeps the inline-backward fused
+  phase in {1,...,7,all,retry} — 4 sweeps the inline-backward fused
   CE; 5 sweeps remat_policy="attn_out" (saved flash residuals); 6 sweeps
   bf16 Adam first moment (mu_dtype) at the memory-capped batches;
+  7 crosses the candidate winners (inline x mu_bf16 x policy);
   "retry" re-runs the points that died on transient remote-compile 500s.
 """
 from __future__ import annotations
@@ -162,6 +163,15 @@ def main():
                 tag = f"p6-mubf16-b{batch}" + ("-inline" if inline else "")
                 run_one(tag, batch=batch, policy="nothing", chunk=4096,
                         inline=inline, mu_bf16=True)
+    if phase in ("7", "all"):
+        # cross of the candidate winners: inline CE (no logits-tile
+        # recompute) x bf16 mu (frees HBM) x attn_out (no attention
+        # recompute), at the incumbent batch and the next one up
+        for policy in ("nothing", "attn_out"):
+            for batch in (8, 12):
+                run_one(f"p7-{policy}-b{batch}-inline-mubf16",
+                        batch=batch, policy=policy, chunk=4096,
+                        inline=True, mu_bf16=True)
     if phase == "retry":
         # re-run the points that died on transient remote-compile HTTP
         # 500s (VERDICT r4 weak #2) — unknowns, not losers
