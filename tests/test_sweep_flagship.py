@@ -4,13 +4,10 @@ shape (RLT_SWEEP_RESULTS redirects the record so the real chip JSONL is
 never polluted; the reference's analog is examples-as-smoke-tests,
 reference .github/workflows/test.yaml:70-77)."""
 import json
-import sys
 
 import pytest
 
-sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
-
-from scripts.sweep_flagship import best_so_far, run_one  # noqa: E402
+from scripts.sweep_flagship import best_so_far, run_one
 
 
 @pytest.fixture
