@@ -21,6 +21,7 @@ def results_path(tmp_path, monkeypatch):
     return p
 
 
+@pytest.mark.slow  # real (tiny) compile + timed steps; tooling, not library
 def test_run_one_records_success_and_flags(results_path, monkeypatch):
     # shrink the model (the real _bench_cfg hardcodes the 0.5B bench
     # dims — minutes of CPU compile); run_one's own measurement path,
