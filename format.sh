@@ -1,8 +1,11 @@
 #!/usr/bin/env bash
 # Lint/format entry point (reference analog: format.sh with yapf+flake8,
-# reference format.sh:1-140). One tool here: ruff handles both roles.
+# reference format.sh:1-140). Two tools: ruff (style, both roles) and
+# shardcheck (`python -m ray_lightning_tpu lint`, docs/STATIC_ANALYSIS.md)
+# for the TPU/JAX-semantics rules ruff cannot know — host transfers in
+# traced code, mesh-axis typos, unhashable static args.
 #
-#   ./format.sh           # fix in place
+#   ./format.sh           # fix in place (+ shardcheck)
 #   ./format.sh --check   # CI mode: fail on violations
 set -euo pipefail
 cd "$(dirname "$0")"
@@ -14,3 +17,8 @@ if [[ "${1:-}" == "--check" ]]; then
 else
     ruff "${RUFF_ARGS[@]}" --fix
 fi
+
+# shardcheck has no fix mode; it gates both invocations identically.
+# examples/ ship user-facing step code, so they are held to the same bar.
+JAX_PLATFORMS=cpu python -m ray_lightning_tpu lint \
+    ray_lightning_tpu examples bench.py __graft_entry__.py

@@ -18,6 +18,15 @@ before queueing for hardware:
     python -m ray_lightning_tpu plan --preset llama3-8b \\
         --fsdp 64 --batch 64 --seq 8192 --device-kind "TPU v5p"
 
+``lint`` runs shardcheck (analysis/): the pre-compile static analyzer
+for sharding plans and jitted training code — mesh-axis typos, host
+transfers inside training_step, Python RNG / wallclock / print in
+traced code, unhashable static args. Zero hardware, target files are
+parsed, never executed:
+
+    python -m ray_lightning_tpu lint ray_lightning_tpu/models
+    python -m ray_lightning_tpu lint my_project.module --json
+
 Exit status: 0 when the plan fits, 1 when it does not, 2 when the
 configuration is invalid (e.g. a global batch not divisible by the
 data-parallel degree — refused rather than planned wrong; the error goes
@@ -145,6 +154,7 @@ def run_plan(args) -> int:
                     cfg, b, args.seq,
                     weight_shard_degree=args.fsdp * args.tensor),
                 device_kind=args.device_kind,
+                hbm_bytes_per_device=args.hbm_bytes,
             )
             # local==0 returns the activation-free plan, whose own
             # summary can read FITS (the weights fit; no batch does) —
@@ -176,6 +186,7 @@ def run_plan(args) -> int:
                 cfg, args.batch // dp, args.seq,
                 weight_shard_degree=args.fsdp * args.tensor),
             device_kind=args.device_kind,
+            hbm_bytes_per_device=args.hbm_bytes,
         )
     except ValueError as exc:
         # a mesh the strategy rejects, a planner refusal — same contract
@@ -213,8 +224,13 @@ def main(argv=None) -> int:
                         help="global batch (rows)")
     plan_p.add_argument("--seq", type=int, default=8192)
     plan_p.add_argument("--device-kind", default="TPU v5p",
-                        choices=("TPU v3", "TPU v4", "TPU v5e", "TPU v5p",
-                                 "TPU v6e"))
+                        help="PJRT device_kind string (e.g. 'TPU v5p'); "
+                             "unknown kinds error with the known list "
+                             "unless --hbm-bytes is given")
+    plan_p.add_argument("--hbm-bytes", type=int, default=None,
+                        help="per-device usable HBM override in bytes — "
+                             "plan hardware the built-in table doesn't "
+                             "know (any --device-kind is then accepted)")
     plan_p.add_argument("--ce-inline-bwd", action="store_true",
                         help="plan with the inline-backward fused CE "
                              "(charges its dx + sharded dW residuals)")
@@ -233,9 +249,14 @@ def main(argv=None) -> int:
     # `--json` given before the subcommand
     plan_p.add_argument("--json", action="store_true", dest="as_json",
                         default=argparse.SUPPRESS)
+    from ray_lightning_tpu.analysis.cli import add_lint_parser, run_lint
+
+    add_lint_parser(sub)
     args = p.parse_args(argv)
     if args.cmd == "plan":
         return run_plan(args)
+    if args.cmd == "lint":
+        return run_lint(args)
     info = collect(probe=args.probe)
     if args.as_json:
         print(json.dumps(info))

@@ -1,0 +1,34 @@
+"""shardcheck — pre-compile static analysis for sharding plans and
+jitted training code.
+
+Two zero-hardware engines sharing one Finding/rule vocabulary
+(docs/STATIC_ANALYSIS.md):
+
+  * plan checker (`check_plan`, plan_checker.py): abstract
+    interpretation over MeshSpec/AbstractMesh + jax.eval_shape — proves
+    a module's PartitionSpec overlay, optimizer-state dtypes, and step
+    donation are well-formed before any pod time is spent;
+  * code linter (`lint_paths`, linter.py): an AST pass over source files
+    (never imported) flagging TPU/JAX antipatterns inside traced code —
+    host transfers, Python RNG/wallclock/print, unhashable static args,
+    unordered iteration — plus mesh-axis typos anywhere.
+
+CLI: `python -m ray_lightning_tpu lint [path|module]` (analysis/cli.py).
+"""
+from ray_lightning_tpu.analysis.findings import (  # noqa: F401
+    RULES, SEVERITY_RANK, Finding, Rule, max_severity, meets,
+)
+from ray_lightning_tpu.analysis.linter import (  # noqa: F401
+    KNOWN_MESH_AXES, TRACED_STEP_HOOKS, lint_paths, lint_source,
+)
+from ray_lightning_tpu.analysis.plan_checker import (  # noqa: F401
+    check_donation, check_opt_state_dtypes, check_param_specs, check_plan,
+    spec_findings,
+)
+
+__all__ = [
+    "RULES", "SEVERITY_RANK", "Finding", "Rule", "max_severity", "meets",
+    "KNOWN_MESH_AXES", "TRACED_STEP_HOOKS", "lint_paths", "lint_source",
+    "check_donation", "check_opt_state_dtypes", "check_param_specs",
+    "check_plan", "spec_findings",
+]

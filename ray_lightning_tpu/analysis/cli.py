@@ -1,0 +1,146 @@
+"""`python -m ray_lightning_tpu lint` — the shardcheck CLI.
+
+Sibling of the doctor/plan subcommands (`__main__.py`): zero hardware,
+runs anywhere Python runs. Targets are files, directories (recursed), or
+importable dotted module names (resolved to their source, never
+executed beyond the import machinery's parent-package resolution).
+
+Exit status: 0 clean (no finding at/above --fail-on), 1 findings at or
+above the gate, 2 invalid invocation (missing path, unresolvable
+module). With --json the report is ONE machine-readable JSON object.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from ray_lightning_tpu.analysis.findings import (
+    RULES, SEVERITY_RANK, Finding, meets,
+)
+from ray_lightning_tpu.analysis.linter import iter_python_files, lint_paths
+
+
+def add_lint_parser(sub) -> None:
+    """Attach the `lint` subparser (argparse) to `sub`."""
+    p = sub.add_parser(
+        "lint",
+        help="static-analyze modules for sharding-plan and traced-code "
+             "antipatterns (no TPU, no target imports)")
+    p.add_argument(
+        "targets", nargs="*", default=None,
+        help="files, directories, or dotted module names (default: the "
+             "installed ray_lightning_tpu package)")
+    p.add_argument(
+        "--severity", choices=("note", "warning", "error"), default="note",
+        help="minimum severity to report (default: note — everything)")
+    p.add_argument(
+        "--fail-on", choices=("note", "warning", "error"), default="error",
+        help="exit 1 when any finding is at/above this severity "
+             "(default: error)")
+    p.add_argument(
+        "--disable", default="",
+        help="comma-separated rule ids to drop entirely (e.g. RLT204)")
+    p.add_argument(
+        "--mesh-axes", default="",
+        help="comma-separated EXTRA mesh-axis names to accept in "
+             "PartitionSpec literals beyond the canonical six")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule table and exit")
+    # same namespace-sharing contract as the plan subparser: a plain
+    # default would clobber a `--json` given before the subcommand
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   default=argparse.SUPPRESS)
+
+
+def _resolve_target(target: str) -> Optional[str]:
+    """A path stays a path; a dotted name resolves to its source file
+    (or package directory)."""
+    if os.path.exists(target):
+        return target
+    if os.sep in target or target.endswith(".py"):
+        return None
+    import importlib.util
+
+    try:
+        spec = importlib.util.find_spec(target)
+    except (ImportError, ValueError, ModuleNotFoundError):
+        return None
+    if spec is None:
+        return None
+    if spec.submodule_search_locations:
+        return list(spec.submodule_search_locations)[0]
+    return spec.origin
+
+
+def run_lint(args) -> int:
+    as_json = getattr(args, "as_json", False)
+    if args.list_rules:
+        if as_json:
+            print(json.dumps({rid: {
+                "name": r.name, "severity": r.severity,
+                "summary": r.summary} for rid, r in sorted(RULES.items())}))
+        else:
+            for rid, r in sorted(RULES.items()):
+                print(f"{rid}  {r.severity:<8} {r.name}: {r.summary}")
+        return 0
+
+    targets = args.targets or [os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))]
+    resolved: List[str] = []
+    for t in targets:
+        r = _resolve_target(t)
+        if r is None:
+            msg = (f"no such file, directory, or importable module: "
+                   f"{t!r}")
+            if as_json:
+                print(json.dumps({"error": msg}))
+            else:
+                print(f"error: {msg}", file=sys.stderr)
+            return 2
+        resolved.append(r)
+
+    extra_axes = tuple(a.strip() for a in args.mesh_axes.split(",")
+                       if a.strip())
+    disabled = {r.strip() for r in args.disable.split(",") if r.strip()}
+    min_rank = SEVERITY_RANK[args.severity]
+
+    # expand the tree ONCE: lint_paths on plain file paths does no walk,
+    # so the count and the linted set cannot disagree
+    files = iter_python_files(resolved)
+    findings = [
+        f for f in lint_paths(files, extra_axes=extra_axes)
+        if f.rule not in disabled and SEVERITY_RANK[f.severity] >= min_rank
+    ]
+    findings.sort(key=lambda f: (f.file or "", f.line or 0, f.rule))
+
+    gate_hit = meets(findings, args.fail_on)
+    counts = {"error": 0, "warning": 0, "note": 0}
+    for f in findings:
+        counts[f.severity] += 1
+    n_files = len(files)
+    if as_json:
+        print(json.dumps({
+            "ok": not gate_hit,
+            "files": n_files,
+            "fail_on": args.fail_on,
+            "counts": counts,
+            "findings": [f.to_dict() for f in findings],
+        }))
+    else:
+        for f in findings:
+            print(f.format())
+        total = sum(counts.values())
+        print(f"checked {n_files} file(s): {total} finding(s) "
+              f"({counts['error']} error, {counts['warning']} warning, "
+              f"{counts['note']} note)"
+              + ("" if not gate_hit else
+                 f" — failing (gate: {args.fail_on})"))
+    return 1 if gate_hit else 0
+
+
+def format_findings(findings: List[Finding]) -> str:
+    """Convenience for embedding reports in exceptions/tests."""
+    return "\n".join(f.format() for f in findings)

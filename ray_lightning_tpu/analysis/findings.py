@@ -1,0 +1,142 @@
+"""Finding/rule vocabulary shared by both shardcheck engines.
+
+One `Finding` type and one rule registry serve the AST linter
+(analysis/linter.py) and the abstract-interpretation plan checker
+(analysis/plan_checker.py) so the CLI, the JSON artifact, and the
+suppression syntax (`# rlt: disable=RULE`) are engine-agnostic: a rule id
+means the same defect whether it was proven from source text or from an
+eval_shape'd parameter pytree (RLT101/RLT103 are emitted by both).
+
+Severity contract (docs/STATIC_ANALYSIS.md):
+  error   — the training job will fail, silently mis-shard, or recompile
+            per step at scale; the lint CLI's default fail gate
+  warning — a footgun that costs memory/determinism but may be intended
+  note    — informational
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+#: severity name -> rank, for threshold comparisons
+SEVERITY_RANK: Dict[str, int] = {"note": 0, "warning": 1, "error": 2}
+
+#: the TpuModule hooks the Trainer compiles under jax.jit — their bodies
+#: run under a tracer. Defined HERE (the analysis package's only
+#: dependency-free module) so the AST linter stays importable without
+#: jax/optax; core/module.py re-exports it as the protocol constant.
+TRACED_STEP_HOOKS: Tuple[str, ...] = (
+    "training_step", "validation_step", "test_step", "predict_step",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    severity: str  # default severity; findings may not override upward
+    summary: str
+
+
+#: every shardcheck rule, both engines (docs/STATIC_ANALYSIS.md is the
+#: prose companion — keep the two in sync)
+RULES: Dict[str, Rule] = {r.id: r for r in (
+    Rule("RLT001", "parse-error", "error",
+         "target file does not parse; nothing else can be checked"),
+    Rule("RLT101", "unknown-mesh-axis", "error",
+         "PartitionSpec names a mesh axis that does not exist (typo'd "
+         "axes are silently dropped -> the leaf replicates -> OOM at "
+         "scale)"),
+    Rule("RLT102", "uneven-shard", "error",
+         "a sharded dim is not divisible by its mesh axis product; the "
+         "leaf cannot be partitioned evenly"),
+    Rule("RLT103", "duplicate-mesh-axis", "error",
+         "the same mesh axis appears twice in one PartitionSpec"),
+    Rule("RLT104", "spec-rank-mismatch", "error",
+         "PartitionSpec has more entries than the parameter has dims"),
+    Rule("RLT105", "opt-dtype-widening", "warning",
+         "optimizer-state leaf stored wider than its parameter "
+         "(silent multi-x optimizer HBM)"),
+    Rule("RLT106", "donation-mismatch", "error",
+         "a donated input buffer has no output with matching "
+         "shape/dtype/sharding to alias; the donation is wasted"),
+    Rule("RLT107", "stale-spec-path", "warning",
+         "param_specs path matches no parameter (renamed layer? the "
+         "spec silently does nothing)"),
+    Rule("RLT201", "host-transfer-in-step", "error",
+         "host transfer (.item()/device_get/np.asarray/...) inside "
+         "traced code forces a device sync per step"),
+    Rule("RLT202", "python-rng-in-step", "error",
+         "Python/numpy RNG inside traced code is baked in at trace "
+         "time (same 'random' numbers every step); use jax.random"),
+    Rule("RLT203", "wallclock-in-step", "warning",
+         "time.time()/datetime.now() inside traced code runs at trace "
+         "time only, not per step"),
+    Rule("RLT204", "print-in-step", "warning",
+         "print() inside traced code fires at trace time only; use "
+         "jax.debug.print for runtime values"),
+    Rule("RLT205", "unhashable-static-arg", "error",
+         "static argument of a jitted function is unhashable (or names "
+         "a parameter that does not exist) — TypeError or a recompile "
+         "per call"),
+    Rule("RLT206", "unordered-iteration", "warning",
+         "iteration over an unordered collection (set/vars()) while "
+         "building traced structure; pytree order can differ across "
+         "processes"),
+)}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One defect, pointing either at source (file/line/col) or at a
+    pytree location (symbol, e.g. a param path)."""
+
+    rule: str
+    message: str
+    severity: Optional[str] = None  # default: the rule's severity
+    file: Optional[str] = None
+    line: Optional[int] = None
+    col: Optional[int] = None
+    symbol: Optional[str] = None
+
+    def __post_init__(self):
+        if self.rule not in RULES:
+            raise ValueError(f"unknown rule id {self.rule!r}")
+        if self.severity is None:
+            object.__setattr__(self, "severity", RULES[self.rule].severity)
+        elif self.severity not in SEVERITY_RANK:
+            raise ValueError(f"unknown severity {self.severity!r}")
+
+    def to_dict(self) -> dict:
+        d = {"rule": self.rule, "name": RULES[self.rule].name,
+             "severity": self.severity, "message": self.message}
+        for k in ("file", "line", "col", "symbol"):
+            v = getattr(self, k)
+            if v is not None:
+                d[k] = v
+        return d
+
+    def format(self) -> str:
+        loc = ""
+        if self.file is not None:
+            loc = self.file
+            if self.line is not None:
+                loc += f":{self.line}"
+                if self.col is not None:
+                    loc += f":{self.col}"
+            loc += ": "
+        elif self.symbol is not None:
+            loc = f"{self.symbol}: "
+        tail = f" [{self.symbol}]" if self.file and self.symbol else ""
+        return (f"{loc}{self.severity} {self.rule} "
+                f"({RULES[self.rule].name}): {self.message}{tail}")
+
+
+def max_severity(findings) -> int:
+    """Highest severity rank present (-1 when clean)."""
+    return max((SEVERITY_RANK[f.severity] for f in findings), default=-1)
+
+
+def meets(findings, threshold: str) -> bool:
+    """True when any finding is at or above `threshold`."""
+    return max_severity(findings) >= SEVERITY_RANK[threshold]

@@ -1,0 +1,522 @@
+"""shardcheck code linter: AST pass over user modules for TPU/JAX
+antipatterns that surface as per-step host syncs, recompile storms, or
+cross-process nondeterminism only AFTER minutes of pod queueing.
+
+Zero hardware, zero target-module imports: files are parsed, never
+executed, so a module with a top-level `jax.distributed.initialize()`
+lints as safely as a pure one.
+
+What counts as *traced code* (the scope where the RLT2xx rules fire):
+
+  * the TpuModule step hooks (training_step/validation_step/test_step/
+    predict_step — core/module.py TRACED_STEP_HOOKS): the Trainer jits
+    them, so their bodies run under a tracer;
+  * functions decorated with jit-family transforms (`@jax.jit`,
+    `@partial(jax.jit, ...)`, `@nn.compact`, `@nn.remat`,
+    `@jax.checkpoint`, `@jax.custom_vjp`, grad/vmap/scan wrappers);
+  * local functions passed to a jit-family call (`step = jax.jit(step)`);
+  * anything those functions call, resolved within the same file
+    (`self.helper(...)` -> the method; `helper(...)` -> the module-level
+    def) to a fixpoint — a host transfer hidden two helpers deep under
+    `training_step` is still a host transfer per step.
+
+Mesh-axis literal rules (RLT101/RLT103) fire anywhere in the file: a
+`PartitionSpec("fdsp")` typo is wrong wherever it appears, and today's
+composition logic would silently DROP the unknown axis (the leaf
+replicates — the exact OOM-at-scale the motivation names).
+
+Suppression: `# rlt: disable=RLT201` (comma-separate for several, bare
+`# rlt: disable` for all) on the offending line;
+`# rlt: disable-file=RLT204` anywhere disables a rule for the file.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from ray_lightning_tpu.analysis.findings import (  # noqa: F401
+    Finding, TRACED_STEP_HOOKS,
+)
+
+#: canonical mesh-axis vocabulary (parallel/mesh.py AXIS_ORDER, inlined
+#: so the linter parses files without importing jax)
+KNOWN_MESH_AXES: Tuple[str, ...] = (
+    "data", "pipe", "fsdp", "expert", "seq", "tensor",
+)
+
+#: dotted names that make the decorated/wrapped function traced
+_TRACE_TRANSFORMS: Set[str] = {
+    "jax.jit", "jit", "pjit", "jax.pmap", "pmap",
+    "nn.compact", "nn.remat", "nn.jit", "flax.linen.compact",
+    "jax.checkpoint", "checkpoint", "jax.remat", "remat",
+    "jax.custom_vjp", "custom_vjp", "jax.custom_jvp", "custom_jvp",
+    "jax.vmap", "vmap", "jax.grad", "grad",
+    "jax.value_and_grad", "value_and_grad",
+    "jax.eval_shape", "jax.lax.scan", "lax.scan",
+}
+
+_HOST_TRANSFER_CALLS: Set[str] = {
+    "jax.device_get", "jax.block_until_ready",
+    "np.asarray", "np.array", "np.ascontiguousarray",
+    "numpy.asarray", "numpy.array", "numpy.ascontiguousarray",
+}
+_HOST_TRANSFER_METHODS: Set[str] = {
+    "item", "tolist", "block_until_ready", "numpy",
+}
+
+_WALLCLOCK_CALLS: Set[str] = {
+    "time.time", "time.time_ns", "time.perf_counter", "time.monotonic",
+    "time.process_time", "datetime.now", "datetime.utcnow",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+}
+
+_RNG_ROOTS: Tuple[str, ...] = ("random.", "np.random.", "numpy.random.")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*rlt:\s*disable(?P<scope>-file)?(?:\s*=\s*(?P<rules>[A-Z0-9,\s]+))?"
+)
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_trace_transform(expr: ast.AST) -> bool:
+    """True when `expr` (a decorator or a call's func) is a jit-family
+    transform — directly, or through `partial(jax.jit, ...)`."""
+    name = _dotted(expr)
+    if name in _TRACE_TRANSFORMS:
+        return True
+    if isinstance(expr, ast.Call):
+        fname = _dotted(expr.func)
+        if fname in _TRACE_TRANSFORMS:
+            return True  # e.g. @jax.checkpoint(policy=...)
+        if fname in ("partial", "functools.partial") and expr.args:
+            return _is_trace_transform(expr.args[0])
+    return False
+
+
+class _Func:
+    """One function/method with enough context for traced-set fixpoint."""
+
+    __slots__ = ("node", "qualname", "cls", "parent", "calls", "traced")
+
+    def __init__(self, node, qualname: str, cls: Optional[str],
+                 parent: Optional["_Func"]):
+        self.node = node
+        self.qualname = qualname
+        self.cls = cls
+        self.parent = parent
+        self.calls: Set[Tuple[str, str]] = set()  # ("self"|"name", name)
+        self.traced = False
+
+
+class _Collector(ast.NodeVisitor):
+    """First pass: function table, call edges, traced seeds, and the
+    spec-literal checks (which are scope-independent)."""
+
+    def __init__(self, linter: "_FileLint"):
+        self.lint = linter
+        self._cls: List[str] = []
+        self._fn: List[_Func] = []
+        self.funcs: List[_Func] = []
+        #: simple name -> funcs (cheap resolution for bare calls)
+        self.by_name: Dict[str, List[_Func]] = {}
+        #: (cls, name) -> func, for self.x(...) resolution
+        self.by_method: Dict[Tuple[str, str], _Func] = {}
+        self.spec_ctors: Set[str] = {"PartitionSpec"}
+
+    # ---- imports: which local names mean PartitionSpec -------------------
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        if node.module and node.module.startswith("jax"):
+            for alias in node.names:
+                if alias.name == "PartitionSpec":
+                    self.spec_ctors.add(alias.asname or alias.name)
+        self.generic_visit(node)
+
+    # ---- function table --------------------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self._cls.append(node.name)
+        self.generic_visit(node)
+        self._cls.pop()
+
+    def _handle_func(self, node):
+        cls = self._cls[-1] if self._cls else None
+        parent = self._fn[-1] if self._fn else None
+        prefix = (parent.qualname + ".") if parent else (
+            (cls + ".") if cls else "")
+        fn = _Func(node, prefix + node.name, cls, parent)
+        self.funcs.append(fn)
+        self.by_name.setdefault(node.name, []).append(fn)
+        if cls is not None and parent is None:
+            self.by_method[(cls, node.name)] = fn
+
+        if any(_is_trace_transform(d) for d in node.decorator_list):
+            fn.traced = True
+        if cls is not None and node.name in TRACED_STEP_HOOKS:
+            fn.traced = True
+
+        self._check_static_args(fn)
+
+        self._fn.append(fn)
+        self.generic_visit(node)
+        self._fn.pop()
+
+    visit_FunctionDef = _handle_func
+    visit_AsyncFunctionDef = _handle_func
+
+    # ---- calls: edges, call-form jit, spec literals ----------------------
+
+    def visit_Call(self, node: ast.Call):
+        cur = self._fn[-1] if self._fn else None
+        if cur is not None:
+            if isinstance(node.func, ast.Name):
+                cur.calls.add(("name", node.func.id))
+            elif (isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self"):
+                cur.calls.add(("self", node.func.attr))
+
+        # call-form wrapping: jax.jit(step, ...) makes local `step` traced
+        if _is_trace_transform(node.func) and node.args:
+            target = node.args[0]
+            if isinstance(target, ast.Name):
+                for fn in self.by_name.get(target.id, ()):
+                    fn.traced = True
+            self._check_static_args_call(node)
+
+        fname = _dotted(node.func)
+        if fname is not None and (
+                fname in self.spec_ctors
+                or fname.split(".")[-1] == "PartitionSpec"):
+            self._check_spec_literal(node)
+        self.generic_visit(node)
+
+    # ---- rule bodies -----------------------------------------------------
+
+    def _check_spec_literal(self, node: ast.Call):
+        axes: List[Tuple[str, ast.AST]] = []
+        for arg in node.args:
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                axes.append((arg.value, arg))
+            elif isinstance(arg, ast.Tuple):
+                for el in arg.elts:
+                    if (isinstance(el, ast.Constant)
+                            and isinstance(el.value, str)):
+                        axes.append((el.value, el))
+        seen: Set[str] = set()
+        for name, anode in axes:
+            if name not in self.lint.known_axes:
+                self.lint.add(
+                    "RLT101",
+                    f"PartitionSpec axis {name!r} is not a mesh axis "
+                    f"(known: {', '.join(self.lint.known_axes)}); the "
+                    "composition logic would silently drop it and "
+                    "replicate the leaf",
+                    anode)
+            if name in seen:
+                self.lint.add(
+                    "RLT103",
+                    f"mesh axis {name!r} used twice in one PartitionSpec",
+                    anode)
+            seen.add(name)
+
+    def _static_names(self, call: ast.Call) -> Tuple[List[int], List[str]]:
+        nums: List[int] = []
+        names: List[str] = []
+        for kw in call.keywords:
+            if kw.arg == "static_argnums":
+                nums.extend(v for v in _const_seq(kw.value)
+                            if isinstance(v, int))
+            elif kw.arg == "static_argnames":
+                names.extend(v for v in _const_seq(kw.value)
+                             if isinstance(v, str))
+        return nums, names
+
+    def _check_static_args(self, fn: _Func):
+        """Decorator form: @partial(jax.jit, static_argnums=...) over a
+        def whose static params must exist and be hashable."""
+        for deco in fn.node.decorator_list:
+            call = deco
+            if (isinstance(deco, ast.Call)
+                    and _dotted(deco.func) in ("partial", "functools.partial")
+                    and deco.args and _is_trace_transform(deco.args[0])):
+                call = deco
+            elif not (isinstance(deco, ast.Call)
+                      and _is_trace_transform(deco.func)):
+                continue
+            self._check_static_against(call, fn.node)
+
+    def _check_static_args_call(self, node: ast.Call):
+        """Call form: jax.jit(f, static_argnames=...) with local f."""
+        target = node.args[0]
+        if not isinstance(target, ast.Name):
+            return
+        defs = self.by_name.get(target.id, ())
+        for fn in defs:
+            self._check_static_against(node, fn.node)
+
+    def _check_static_against(self, call: ast.Call, fndef):
+        nums, names = self._static_names(call)
+        if not nums and not names:
+            return
+        args = fndef.args
+        params = ([a.arg for a in args.posonlyargs]
+                  + [a.arg for a in args.args])
+        kwonly = [a.arg for a in args.kwonlyargs]
+        defaults: Dict[str, ast.AST] = {}
+        pos_defaults = args.defaults
+        for p, d in zip(params[len(params) - len(pos_defaults):],
+                        pos_defaults):
+            defaults[p] = d
+        for p, d in zip(kwonly, args.kw_defaults):
+            if d is not None:
+                defaults[p] = d
+        for i in nums:
+            if i >= len(params):
+                self.lint.add(
+                    "RLT205",
+                    f"static_argnums={i} is out of range for "
+                    f"{fndef.name}() ({len(params)} positional params)",
+                    call)
+            elif _unhashable_default(defaults.get(params[i])):
+                self.lint.add(
+                    "RLT205",
+                    f"static arg {params[i]!r} of {fndef.name}() has an "
+                    "unhashable default (list/dict/set) — jit will "
+                    "TypeError or retrace per call",
+                    call)
+        for n in names:
+            if n not in params and n not in kwonly:
+                self.lint.add(
+                    "RLT205",
+                    f"static_argnames names {n!r} which is not a "
+                    f"parameter of {fndef.name}() — the intended arg "
+                    "stays traced and every new value recompiles",
+                    call)
+            elif _unhashable_default(defaults.get(n)):
+                self.lint.add(
+                    "RLT205",
+                    f"static arg {n!r} of {fndef.name}() has an "
+                    "unhashable default (list/dict/set) — jit will "
+                    "TypeError or retrace per call",
+                    call)
+
+
+def _const_seq(node: ast.AST) -> List:
+    if isinstance(node, ast.Constant):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [el.value for el in node.elts
+                if isinstance(el, ast.Constant)]
+    return []
+
+
+def _unhashable_default(node: Optional[ast.AST]) -> bool:
+    if node is None:
+        return False
+    if isinstance(node, (ast.List, ast.Dict, ast.Set,
+                         ast.ListComp, ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        return _dotted(node.func) in ("list", "dict", "set")
+    return False
+
+
+def _is_unordered_iterable(node: ast.AST) -> Optional[str]:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return "a set literal"
+    if isinstance(node, ast.Call):
+        name = _dotted(node.func)
+        if name in ("set", "frozenset"):
+            return f"{name}()"
+        if name == "vars":
+            return "vars()"
+    if isinstance(node, ast.Attribute) and node.attr == "__dict__":
+        return "__dict__"
+    return None
+
+
+class _FileLint:
+    """Per-file state: source, suppressions, findings."""
+
+    def __init__(self, source: str, filename: str,
+                 extra_axes: Sequence[str] = ()):
+        self.filename = filename
+        self.known_axes = tuple(KNOWN_MESH_AXES) + tuple(extra_axes)
+        self.findings: List[Finding] = []
+        self._line_off: Dict[int, Set[str]] = {}
+        self._file_off: Set[str] = set()
+        for i, text in enumerate(source.splitlines(), start=1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip() for r in (m.group("rules") or "").split(",")
+                     if r.strip()} or {"*"}
+            if m.group("scope"):
+                self._file_off |= rules
+            else:
+                self._line_off.setdefault(i, set()).update(rules)
+
+    def add(self, rule: str, message: str, node: Optional[ast.AST] = None,
+            symbol: Optional[str] = None):
+        line = getattr(node, "lineno", None)
+        off = self._line_off.get(line, set()) | self._file_off
+        if rule in off or "*" in off:
+            return
+        self.findings.append(Finding(
+            rule=rule, message=message, file=self.filename, line=line,
+            col=getattr(node, "col_offset", None), symbol=symbol,
+        ))
+
+
+def _own_nodes(fn_node) -> Iterable[ast.AST]:
+    """All nodes of a function body EXCLUDING nested function defs (each
+    nested def is linted as its own traced function); lambdas belong to
+    the enclosing function."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _lint_traced_body(lint: _FileLint, fn: _Func) -> None:
+    sym = fn.qualname
+    for node in _own_nodes(fn.node):
+        if isinstance(node, ast.Call):
+            fname = _dotted(node.func)
+            if fname in _HOST_TRANSFER_CALLS:
+                lint.add("RLT201",
+                         f"{fname}() inside traced code is a host "
+                         "transfer — a device sync every step; keep "
+                         "values on device (or move this out of the "
+                         "step)", node, sym)
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _HOST_TRANSFER_METHODS
+                    and not node.args and not node.keywords):
+                lint.add("RLT201",
+                         f".{node.func.attr}() inside traced code is a "
+                         "host transfer — a device sync every step",
+                         node, sym)
+            elif fname is not None and fname.startswith(_RNG_ROOTS):
+                lint.add("RLT202",
+                         f"{fname}() is Python/numpy RNG: its value is "
+                         "baked in at trace time, so every step reuses "
+                         "the same 'random' numbers — thread a jax "
+                         "PRNG key instead", node, sym)
+            elif fname in _WALLCLOCK_CALLS:
+                lint.add("RLT203",
+                         f"{fname}() runs at trace time only — the "
+                         "compiled step will reuse one stale timestamp "
+                         "forever", node, sym)
+            elif fname == "print":
+                lint.add("RLT204",
+                         "print() in traced code fires once, at trace "
+                         "time, showing tracers not values — use "
+                         "jax.debug.print for runtime values", node, sym)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            what = _is_unordered_iterable(node.iter)
+            if what:
+                lint.add("RLT206",
+                         f"iterating {what} in traced code: unordered "
+                         "iteration makes pytree/program order "
+                         "nondeterministic across processes — sort it",
+                         node, sym)
+        elif isinstance(node, ast.comprehension):
+            what = _is_unordered_iterable(node.iter)
+            if what:
+                lint.add("RLT206",
+                         f"comprehension over {what} in traced code: "
+                         "unordered iteration makes pytree/program "
+                         "order nondeterministic across processes — "
+                         "sort it", node.iter, sym)
+
+
+def lint_source(source: str, filename: str = "<string>",
+                extra_axes: Sequence[str] = ()) -> List[Finding]:
+    """Lint one file's source text. Never imports the target."""
+    lint = _FileLint(source, filename, extra_axes)
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as exc:
+        lint.add("RLT001", f"does not parse: {exc.msg}",
+                 type("_N", (), {"lineno": exc.lineno or 1,
+                                 "col_offset": exc.offset or 0})())
+        return lint.findings
+
+    coll = _Collector(lint)
+    coll.visit(tree)
+
+    # traced-set fixpoint: containment + same-file call edges
+    changed = True
+    while changed:
+        changed = False
+        for fn in coll.funcs:
+            if fn.traced:
+                continue
+            if fn.parent is not None and fn.parent.traced:
+                fn.traced = True
+                changed = True
+                continue
+        for fn in coll.funcs:
+            if not fn.traced:
+                continue
+            for kind, name in fn.calls:
+                if kind == "self" and fn.cls is not None:
+                    callee = coll.by_method.get((fn.cls, name))
+                    if callee is not None and not callee.traced:
+                        callee.traced = True
+                        changed = True
+                elif kind == "name":
+                    for callee in coll.by_name.get(name, ()):
+                        # bare-name calls resolve to module-level defs
+                        # only (a method never shadows a global name)
+                        if callee.cls is None and callee.parent is None \
+                                and not callee.traced:
+                            callee.traced = True
+                            changed = True
+
+    for fn in coll.funcs:
+        if fn.traced:
+            _lint_traced_body(lint, fn)
+    return lint.findings
+
+
+def iter_python_files(targets: Sequence[str]) -> List[str]:
+    """Expand files / directories (recursively) to .py paths."""
+    out: List[str] = []
+    for t in targets:
+        if os.path.isdir(t):
+            for root, dirs, files in os.walk(t):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                out.extend(os.path.join(root, f) for f in sorted(files)
+                           if f.endswith(".py"))
+        else:
+            out.append(t)
+    return out
+
+
+def lint_paths(paths: Sequence[str],
+               extra_axes: Sequence[str] = ()) -> List[Finding]:
+    """Lint files and/or directories; returns all findings."""
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        with open(path, "r", encoding="utf-8") as fh:
+            findings.extend(lint_source(fh.read(), path, extra_axes))
+    return findings

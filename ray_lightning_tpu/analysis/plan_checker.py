@@ -1,0 +1,298 @@
+"""shardcheck plan checker: abstract interpretation over
+MeshSpec/AbstractMesh + jax.eval_shape that proves a module's sharding
+plan is WELL-FORMED before any pod time is spent.
+
+parallel/plan.py proves a plan *fits* (byte counts vs HBM); this engine
+proves the plan *means what the author thinks*: every axis name exists,
+every sharded dim divides, no axis is used twice, the optimizer state
+doesn't silently widen, and donated buffers actually alias. All checks
+run on `jax.eval_shape` abstractions over a `jax.sharding.AbstractMesh`
+— zero devices of any kind, so an 8-chip dev box (or a CPU laptop) can
+check a 4096-chip plan.
+
+The composition code in parallel/strategy.py calls `spec_findings` on
+every composed spec and raises on error-level findings, so the same
+rules guard the live Trainer path, not only the offline checker.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+from ray_lightning_tpu.analysis.findings import Finding
+
+__all__ = [
+    "spec_entries", "spec_findings", "check_param_specs",
+    "check_opt_state_dtypes", "check_donation", "check_plan",
+]
+
+
+def spec_entries(spec) -> List[Tuple[int, Tuple[str, ...]]]:
+    """Flatten a PartitionSpec-like into (dim_index, axis_names) pairs;
+    unsharded dims yield empty tuples."""
+    out: List[Tuple[int, Tuple[str, ...]]] = []
+    for i, entry in enumerate(tuple(spec)):
+        if entry is None:
+            out.append((i, ()))
+        elif isinstance(entry, (tuple, list)):
+            out.append((i, tuple(entry)))
+        else:
+            out.append((i, (entry,)))
+    return out
+
+
+def spec_findings(
+    spec,
+    shape: Sequence[int],
+    mesh_sizes: Mapping[str, int],
+    *,
+    path: str = "<leaf>",
+) -> List[Finding]:
+    """Validate ONE spec against one leaf shape and a mesh: unknown axes
+    (RLT101), duplicate axes (RLT103), rank overflow (RLT104), uneven
+    shard dims (RLT102)."""
+    findings: List[Finding] = []
+    entries = spec_entries(spec)
+    seen: Dict[str, int] = {}
+    for i, names in entries:
+        for ax in names:
+            if ax not in mesh_sizes:
+                findings.append(Finding(
+                    "RLT101",
+                    f"spec for {path} names mesh axis {ax!r} which does "
+                    f"not exist (mesh axes: {sorted(mesh_sizes)}); the "
+                    "composition logic would silently drop it and "
+                    "replicate the leaf", symbol=path))
+            if ax in seen:
+                findings.append(Finding(
+                    "RLT103",
+                    f"spec for {path} uses mesh axis {ax!r} on dims "
+                    f"{seen[ax]} and {i}; an axis can shard at most one "
+                    "dim", symbol=path))
+            seen.setdefault(ax, i)
+    rank = len(shape)
+    if len(entries) > rank:
+        findings.append(Finding(
+            "RLT104",
+            f"spec for {path} has {len(entries)} entries but the leaf "
+            f"has rank {rank} (shape {tuple(shape)})", symbol=path))
+        return findings
+    for i, names in entries:
+        divisor = math.prod(mesh_sizes.get(ax, 1) for ax in names)
+        if divisor > 1 and shape[i] % divisor != 0:
+            findings.append(Finding(
+                "RLT102",
+                f"dim {i} of {path} (size {shape[i]}, shape "
+                f"{tuple(shape)}) cannot be partitioned evenly by "
+                f"{'x'.join(names)} (={divisor})", symbol=path))
+    return findings
+
+
+def check_param_specs(
+    specs: Optional[Mapping[str, Any]],
+    named_params: Mapping[str, Any],
+    mesh_sizes: Mapping[str, int],
+) -> List[Finding]:
+    """Validate a module's raw `param_specs()` overlay against the
+    (abstract) parameter pytree: per-spec structural rules plus stale
+    paths that match no parameter (RLT107)."""
+    findings: List[Finding] = []
+    for path, spec in (specs or {}).items():
+        leaf = named_params.get(path)
+        if leaf is None:
+            findings.append(Finding(
+                "RLT107",
+                f"param_specs path {path!r} matches no parameter "
+                "(renamed layer? the spec silently does nothing). "
+                f"Nearest params: {_nearest(path, named_params)}",
+                symbol=path))
+            continue
+        findings.extend(spec_findings(
+            spec, getattr(leaf, "shape", ()), mesh_sizes, path=path))
+    return findings
+
+
+def _nearest(path: str, named_params: Mapping[str, Any], k: int = 3) -> str:
+    tail = path.split("/")[-1]
+    hits = [p for p in named_params if p.split("/")[-1] == tail][:k]
+    return ", ".join(hits) if hits else "(none share the leaf name)"
+
+
+def check_opt_state_dtypes(named_params: Mapping[str, Any],
+                           named_opt: Mapping[str, Any]) -> List[Finding]:
+    """Dtype-widening hazards: an optimizer-state leaf stored WIDER than
+    the parameter it tracks (matched by the same longest-path-suffix +
+    shape rule the strategies use for opt-state sharding inheritance)
+    silently multiplies optimizer HBM — e.g. f32 Adam moments over bf16
+    params are 2x the bytes the author likely budgeted."""
+    findings: List[Finding] = []
+    by_path = {p: leaf for p, leaf in named_params.items()}
+    for opath, oleaf in named_opt.items():
+        oshape = getattr(oleaf, "shape", None)
+        odtype = getattr(oleaf, "dtype", None)
+        if oshape is None or odtype is None:
+            continue
+        parts = opath.split("/")
+        match = None
+        for i in range(len(parts)):
+            cand = "/".join(parts[i:])
+            leaf = by_path.get(cand)
+            if leaf is not None and getattr(leaf, "shape", ()) == oshape:
+                match = (cand, leaf)
+                break
+        if match is None:
+            continue
+        ppath, pleaf = match
+        p_size = getattr(pleaf.dtype, "itemsize", None)
+        o_size = getattr(odtype, "itemsize", None)
+        if p_size and o_size and o_size > p_size:
+            findings.append(Finding(
+                "RLT105",
+                f"optimizer state {opath} is {odtype} but its param "
+                f"{ppath} is {pleaf.dtype}: the state is "
+                f"{o_size / p_size:g}x wider than the weights it "
+                "tracks (check mu_dtype/accumulator dtypes against the "
+                "memory plan)", symbol=opath))
+    return findings
+
+
+def _leaf_key(leaf, sharding) -> Tuple:
+    spec = getattr(sharding, "spec", sharding)
+    spec_key = tuple(
+        tuple(e) if isinstance(e, (tuple, list)) else e
+        for e in tuple(spec)) if spec is not None else None
+    return (tuple(getattr(leaf, "shape", ())),
+            str(getattr(leaf, "dtype", "")), spec_key)
+
+
+def check_donation(
+    donated_named: Mapping[str, Tuple[Any, Any]],
+    output_named: Mapping[str, Tuple[Any, Any]],
+) -> List[Finding]:
+    """Donation/aliasing audit: every donated input buffer must have an
+    output buffer with identical (shape, dtype, sharding spec) to alias
+    — otherwise XLA cannot reuse the donated memory and the step's true
+    peak is a donated-buffer's-worth higher than planned.
+
+    Both arguments map leaf paths to ``(abstract_leaf, sharding)``
+    pairs (sharding may be None when unsharded); outputs are consumed
+    at most once, mirroring XLA's aliasing rules."""
+    findings: List[Finding] = []
+    pool: Dict[Tuple, int] = {}
+    for _, (leaf, sh) in output_named.items():
+        key = _leaf_key(leaf, sh)
+        pool[key] = pool.get(key, 0) + 1
+    for path, (leaf, sh) in donated_named.items():
+        key = _leaf_key(leaf, sh)
+        if pool.get(key, 0) > 0:
+            pool[key] -= 1
+        else:
+            findings.append(Finding(
+                "RLT106",
+                f"donated input {path} (shape {key[0]}, dtype {key[1]}, "
+                f"spec {key[2]}) has no matching output buffer to alias "
+                "— the donation is wasted and peak memory exceeds the "
+                "plan by this buffer", symbol=path))
+    return findings
+
+
+def check_plan(
+    module,
+    strategy,
+    n_devices: int,
+    example_batch: Any,
+) -> List[Finding]:
+    """Full plan audit for ``module`` trained under ``strategy`` on
+    ``n_devices`` — the well-formedness sibling of
+    `parallel.plan.plan_train_memory` (same abstract build: AbstractMesh
+    + eval_shape, zero devices; like the planner, it consumes the
+    strategy instance — pass a fresh one).
+
+    Returns findings from: the module's raw param_specs overlay
+    (RLT101/102/103/104/107), the strategy-composed shardings (RLT102),
+    optimizer-state dtypes (RLT105), and the canonical donated train
+    step's in/out aliasing (RLT106).
+    """
+    import jax
+
+    from ray_lightning_tpu.ops.dispatch import force_xla
+    from ray_lightning_tpu.parallel.plan import _abstract, abstract_mesh
+    from ray_lightning_tpu.utils.pytree import named_leaves
+
+    spec = strategy.build_spec(n_devices).resolve(n_devices)
+    mesh = abstract_mesh(spec)
+    strategy.spec = spec
+    strategy.mesh = mesh
+    strategy.bind_module(module)
+    module.setup()
+    mesh_sizes = spec.sizes()
+
+    findings: List[Finding] = []
+    a_key = jax.eval_shape(lambda: jax.random.key(0))
+    with force_xla():
+        a_params = jax.eval_shape(
+            module.init_params, a_key, _abstract(example_batch))
+        named_params = dict(named_leaves(a_params))
+
+        raw_specs = None
+        if hasattr(module, "param_specs"):
+            raw_specs = module.param_specs(a_params)
+        findings.extend(
+            check_param_specs(raw_specs, named_params, mesh_sizes))
+
+        # compose through the strategy's real path; structural ERRORS the
+        # raw check already reported would raise here — collect, don't die
+        try:
+            p_shardings = strategy.param_shardings(a_params)
+            tx = module.configure_optimizers()
+            a_opt = jax.eval_shape(tx.init, a_params)
+            o_shardings = strategy.opt_state_shardings(a_opt, a_params)
+        except ValueError:
+            if not any(f.severity == "error" for f in findings):
+                raise  # not a defect the raw pass explained — surface it
+            return findings
+
+    named_opt = dict(named_leaves(a_opt))
+    findings.extend(check_opt_state_dtypes(named_params, named_opt))
+
+    # composed shardings: the fsdp auto-placement only picks divisible
+    # dims, but a module overlay can force an uneven split
+    for (path, leaf), sh in zip(named_params.items(),
+                                jax.tree.leaves(p_shardings)):
+        findings.extend(spec_findings(
+            sh.spec, leaf.shape, mesh_sizes, path=path))
+
+    # donation audit on the canonical train step: (params, opt_state)
+    # donated in, the optimizer update's ACTUAL outputs out — eval_shape
+    # runs the real (grads -> tx.update -> apply_updates) tail so a
+    # dtype/shape drift the optimizer introduces (the Trainer's donated
+    # buffers then cannot alias) is caught, not assumed away
+    donated = {f"params/{p}": (leaf, sh) for (p, leaf), sh in zip(
+        named_params.items(), jax.tree.leaves(p_shardings))}
+    donated.update({f"opt_state/{p}": (leaf, sh) for (p, leaf), sh in zip(
+        named_opt.items(), jax.tree.leaves(o_shardings))})
+
+    def _update_tail(params, opt_state):
+        import optax
+
+        # grads materialize at param shape/dtype during the step
+        grads = jax.tree.map(lambda x: x, params)
+        updates, new_opt = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_opt
+
+    try:
+        with force_xla():
+            out_params, out_opt = jax.eval_shape(
+                _update_tail, a_params, a_opt)
+            out_p_sh = strategy.param_shardings(out_params)
+            out_o_sh = strategy.opt_state_shardings(out_opt, out_params)
+    except Exception:  # noqa: BLE001 — an optimizer eval_shape cannot
+        # run abstractly: skip the donation audit rather than fail the
+        # whole check (the other engines' findings still stand)
+        return findings
+    outputs = {f"params/{p}": (leaf, sh) for (p, leaf), sh in zip(
+        named_leaves(out_params), jax.tree.leaves(out_p_sh))}
+    outputs.update({f"opt_state/{p}": (leaf, sh) for (p, leaf), sh in zip(
+        named_leaves(out_opt), jax.tree.leaves(out_o_sh))})
+    findings.extend(check_donation(donated, outputs))
+    return findings

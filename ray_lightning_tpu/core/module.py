@@ -25,6 +25,16 @@ import optax
 Metrics = Dict[str, jnp.ndarray]
 StepOutput = Union[jnp.ndarray, Tuple[jnp.ndarray, Metrics]]
 
+#: the hooks the Trainer compiles under jax.jit — their bodies run under
+#: a tracer, so host transfers / Python RNG / wallclock inside them are
+#: per-step bugs. The shardcheck linter (analysis/linter.py) treats
+#: these names, and everything they call, as traced code; the tuple
+#: lives in analysis/findings.py (dependency-free) and is re-exported
+#: here as the protocol constant.
+from ray_lightning_tpu.analysis.findings import (  # noqa: E402,F401
+    TRACED_STEP_HOOKS,
+)
+
 
 class TpuModule:
     """Subclass and implement the `configure_*` / `*_step` hooks.
@@ -172,6 +182,27 @@ class TpuModule:
         module.params = ckpt["params"]
         module.on_load_checkpoint(ckpt)
         return module
+
+    @classmethod
+    def lint(cls, **lint_kwargs):
+        """shardcheck this module class's source file: the AST linter
+        (analysis/linter.py) over the file that defines the subclass —
+        host transfers / Python RNG / wallclock / print inside the
+        traced step hooks, mesh-axis typos in PartitionSpec literals.
+
+        Returns a list of `analysis.Finding`; empty means clean. The
+        plan-side audit (spec composition, opt dtypes, donation) needs a
+        strategy and lives in `analysis.check_plan(module, strategy,
+        n_devices, example_batch)`.
+        """
+        import inspect
+
+        from ray_lightning_tpu.analysis import lint_paths
+
+        src = inspect.getsourcefile(cls)
+        if src is None:  # dynamically-built class: nothing to parse
+            return []
+        return lint_paths([src], **lint_kwargs)
 
     # Convenience: module(batch) runs predict with stored params.
     def __call__(self, *args, **kwargs):

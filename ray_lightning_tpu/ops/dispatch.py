@@ -49,6 +49,28 @@ def interpret_mode() -> bool:
     return not on_tpu()
 
 
+def shard_map(f, mesh, in_specs, out_specs, *,
+              check_replication: bool = True):
+    """Version-portable shard_map: `jax.shard_map` (current jax, where
+    the replication-check kwarg is `check_vma`) with a fallback to
+    `jax.experimental.shard_map.shard_map` (jax <= 0.4.x, `check_rep`).
+    The manual islands (ring/ulysses attention, the GPipe pipeline) go
+    through here so a jax upgrade/downgrade is one-file work — the same
+    contract as `parallel.plan.abstract_mesh`."""
+    if hasattr(jax, "shard_map"):
+        import inspect
+
+        params = inspect.signature(jax.shard_map).parameters
+        kw = "check_vma" if "check_vma" in params else "check_rep"
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs,
+                             **{kw: check_replication})
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_replication)
+
+
 def use_pallas(override: bool | None = None,
                default: bool | None = None) -> bool:
     """Dispatch decision: explicit argument > force_xla context >

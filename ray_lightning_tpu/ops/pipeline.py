@@ -143,12 +143,14 @@ def gpipe_apply(
         )
         return out.reshape(x_local.shape)
 
-    return jax.shard_map(
+    from ray_lightning_tpu.ops.dispatch import shard_map
+
+    return shard_map(
         local,
         mesh=mesh,
         in_specs=(param_specs, x_spec) + extra_specs,
         out_specs=x_spec,
-        check_vma=False,  # mixes pipe-varying and replicated operands
+        check_replication=False,  # mixes pipe-varying and replicated
     )(stacked_params, x, *extra)
 
 

@@ -1,6 +1,8 @@
 from ray_lightning_tpu.parallel.mesh import MeshSpec, make_mesh, AXIS_ORDER
 from ray_lightning_tpu.parallel.plan import (
     MemoryPlan,
+    find_max_local_batch,
+    hbm_bytes_for_kind,
     llama_activation_bytes,
     plan_train_memory,
 )
@@ -18,6 +20,8 @@ __all__ = [
     "make_mesh",
     "AXIS_ORDER",
     "MemoryPlan",
+    "find_max_local_batch",
+    "hbm_bytes_for_kind",
     "llama_activation_bytes",
     "plan_train_memory",
     "Strategy",

@@ -55,11 +55,41 @@ HBM_BYTES_BY_KIND: Dict[str, int] = {
 
 def abstract_mesh(spec: MeshSpec) -> AbstractMesh:
     """An AbstractMesh with this spec's axis names/sizes — NamedSharding
-    accepts it, `shard_shape` works, and no devices are required."""
+    accepts it, `shard_shape` works, and no devices are required.
+
+    Handles both AbstractMesh signatures: the current
+    ``AbstractMesh(axis_sizes, axis_names)`` and the older
+    ``AbstractMesh(shape_tuple)`` of (name, size) pairs (jax <= 0.4.x),
+    so the planner keeps its zero-device guarantee across the jax
+    versions the runtime supports."""
     sizes = spec.sizes()
-    return AbstractMesh(
-        tuple(sizes[ax] for ax in AXIS_ORDER), AXIS_ORDER
-    )
+    shape = tuple(sizes[ax] for ax in AXIS_ORDER)
+    try:
+        return AbstractMesh(shape, AXIS_ORDER)
+    except TypeError:
+        return AbstractMesh(tuple(zip(AXIS_ORDER, shape)))
+
+
+def hbm_bytes_for_kind(device_kind: str,
+                       hbm_bytes: Optional[int] = None) -> int:
+    """Usable HBM per device for ``device_kind`` — or the explicit
+    ``hbm_bytes`` override for hardware the table doesn't know. An
+    unknown kind without an override raises a ValueError LISTING the
+    known kinds (never a bare KeyError): the planner's most common
+    first-contact failure is a device_kind string that doesn't match the
+    spec-sheet spelling."""
+    if hbm_bytes is not None:
+        if hbm_bytes <= 0:
+            raise ValueError(f"hbm_bytes must be positive, got {hbm_bytes}")
+        return int(hbm_bytes)
+    if device_kind not in HBM_BYTES_BY_KIND:
+        raise ValueError(
+            f"unknown device_kind {device_kind!r} (known: "
+            f"{sorted(HBM_BYTES_BY_KIND)}); pass hbm_bytes_per_device= "
+            "(plan_train_memory) / hbm_bytes= (this helper; CLI "
+            "--hbm-bytes) explicitly for other hardware"
+        )
+    return HBM_BYTES_BY_KIND[device_kind]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -182,14 +212,8 @@ def plan_train_memory(
         a_opt = jax.eval_shape(tx.init, a_params)
         o_shardings = strategy.opt_state_shardings(a_opt, a_params)
 
-    if hbm_bytes_per_device is None:
-        if device_kind not in HBM_BYTES_BY_KIND:
-            raise ValueError(
-                f"unknown device_kind {device_kind!r} (known: "
-                f"{sorted(HBM_BYTES_BY_KIND)}); pass "
-                "hbm_bytes_per_device= explicitly for other hardware"
-            )
-        hbm_bytes_per_device = HBM_BYTES_BY_KIND[device_kind]
+    hbm_bytes_per_device = hbm_bytes_for_kind(
+        device_kind, hbm_bytes_per_device)
     params_dev = _sharded_tree_bytes(a_params, p_shardings)
     opt_dev = _sharded_tree_bytes(a_opt, o_shardings)
     return MemoryPlan(

@@ -125,12 +125,49 @@ class Strategy:
             spec = module_specs.get(path)
             if spec is None:
                 spec = self.param_spec(path, leaf)
+            else:
+                # BEFORE adaptation: _adapt_spec silently drops axes the
+                # mesh doesn't know, so a typo'd axis name would quietly
+                # replicate the leaf — the OOM-at-scale failure the
+                # shardcheck subsystem exists to catch (RLT101)
+                self._require_known_axes(path, spec)
             spec = self._adapt_spec(spec, getattr(leaf, "shape", ()))
+            self._require_well_formed(path, spec,
+                                      getattr(leaf, "shape", ()))
             return NamedSharding(self.mesh, spec)
 
         return jax.tree_util.tree_map_with_path(
             lambda kp, leaf: one(_path_str(kp), leaf), params
         )
+
+    def _require_known_axes(self, path: str, spec: P) -> None:
+        """Raise when a module-provided spec names an axis the mesh does
+        not have at all (distinct from a size-1 axis, which is legal and
+        dropped by _adapt_spec)."""
+        known = set(self.mesh.shape)
+        unknown = sorted(_spec_names(spec) - known)
+        if unknown:
+            raise ValueError(
+                f"param_specs for {path!r} names unknown mesh "
+                f"axis(es) {unknown} (mesh axes: {sorted(known)}) — a "
+                "typo here would silently replicate the leaf "
+                "[shardcheck RLT101]"
+            )
+
+    def _require_well_formed(self, path: str, spec: P, shape) -> None:
+        """Eager structural validation of the COMPOSED spec (shardcheck
+        RLT102/103/104): fail at setup with the leaf's name instead of
+        at compile time with an XLA sharding error."""
+        from ray_lightning_tpu.analysis.plan_checker import spec_findings
+
+        errors = [f for f in spec_findings(
+            spec, shape, dict(self.mesh.shape), path=path)
+            if f.severity == "error"]
+        if errors:
+            raise ValueError(
+                "sharding plan is malformed:\n"
+                + "\n".join(f.format() for f in errors)
+            )
 
     def _adapt_spec(self, spec: P, shape) -> P:
         """Drop mesh axes the strategy's mesh doesn't materialize (size 1)."""

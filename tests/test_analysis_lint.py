@@ -1,0 +1,273 @@
+"""shardcheck code-linter unit tests: every rule fires on a minimal bad
+snippet and stays quiet on the matching good one, suppression works, and
+the bundled models/ops self-lint clean (the framework is held to its own
+bar — docs/STATIC_ANALYSIS.md)."""
+import os
+
+from ray_lightning_tpu.analysis import lint_paths, lint_source
+
+PKG = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "ray_lightning_tpu")
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+def lint(src: str):
+    return lint_source(src, "<test>")
+
+
+# ---- RLT201 host transfer ------------------------------------------------
+
+
+def test_host_transfer_fires_in_training_step():
+    fs = lint(
+        "import numpy as np\n"
+        "class M:\n"
+        "    def training_step(self, params, batch, rng):\n"
+        "        return np.asarray(batch['x']).sum()\n")
+    assert rules_of(fs) == ["RLT201"]
+    assert fs[0].symbol == "M.training_step"
+
+
+def test_host_transfer_method_forms():
+    fs = lint(
+        "class M:\n"
+        "    def validation_step(self, params, batch):\n"
+        "        a = loss.item()\n"
+        "        b = loss.tolist()\n"
+        "        c = loss.block_until_ready()\n")
+    assert [f.rule for f in fs] == ["RLT201"] * 3
+
+
+def test_host_transfer_quiet_outside_traced_code():
+    fs = lint(
+        "import numpy as np\n"
+        "def collate(batch):\n"
+        "    return np.asarray(batch)\n"
+        "class M:\n"
+        "    def on_fit_end(self, trainer):\n"
+        "        return float(np.asarray(1.0))\n")
+    assert fs == []
+
+
+def test_host_transfer_found_through_helper_calls():
+    """Fixpoint propagation: a transfer two helpers deep under a step
+    hook is still a per-step transfer."""
+    fs = lint(
+        "import jax\n"
+        "class M:\n"
+        "    def training_step(self, params, batch, rng):\n"
+        "        return self._loss(params, batch)\n"
+        "    def _loss(self, params, batch):\n"
+        "        return _fetch(params)\n"
+        "def _fetch(p):\n"
+        "    return jax.device_get(p)\n")
+    assert rules_of(fs) == ["RLT201"]
+    assert fs[0].symbol == "_fetch"
+
+
+def test_call_form_jit_marks_local_function():
+    fs = lint(
+        "import jax\n"
+        "def make_step():\n"
+        "    def step(p):\n"
+        "        return p.item()\n"
+        "    return jax.jit(step)\n")
+    assert rules_of(fs) == ["RLT201"]
+
+
+# ---- RLT202 python rng ---------------------------------------------------
+
+
+def test_python_rng_fires_jax_rng_quiet():
+    fs = lint(
+        "import random\n"
+        "import numpy as np\n"
+        "import jax\n"
+        "class M:\n"
+        "    def training_step(self, params, batch, rng):\n"
+        "        a = random.random()\n"
+        "        b = np.random.normal()\n"
+        "        c = jax.random.normal(rng, (2,))\n"
+        "        return a + b + c.sum()\n")
+    assert [f.rule for f in fs] == ["RLT202", "RLT202"]
+
+
+# ---- RLT203 / RLT204 wallclock + print -----------------------------------
+
+
+def test_wallclock_and_print_warn():
+    fs = lint(
+        "import time\n"
+        "class M:\n"
+        "    def training_step(self, params, batch, rng):\n"
+        "        t = time.time()\n"
+        "        print('step at', t)\n"
+        "        return t\n")
+    assert rules_of(fs) == ["RLT203", "RLT204"]
+    assert all(f.severity == "warning" for f in fs)
+
+
+# ---- RLT205 static args --------------------------------------------------
+
+
+def test_unhashable_static_default_fires():
+    fs = lint(
+        "import jax\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, static_argnums=(1,))\n"
+        "def step(params, opts=[1, 2]):\n"
+        "    return params\n")
+    assert rules_of(fs) == ["RLT205"]
+
+
+def test_static_argnames_typo_fires():
+    fs = lint(
+        "import jax\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, static_argnames=('cfgg',))\n"
+        "def step(params, cfg=None):\n"
+        "    return params\n")
+    assert rules_of(fs) == ["RLT205"]
+    assert "cfgg" in fs[0].message
+
+
+def test_wellformed_static_args_quiet():
+    fs = lint(
+        "import jax\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, static_argnums=(1,), static_argnames=('cfg',))\n"
+        "def step(params, cfg=None):\n"
+        "    return params\n")
+    assert fs == []
+
+
+# ---- RLT206 unordered iteration ------------------------------------------
+
+
+def test_set_iteration_warns_sorted_quiet():
+    bad = lint(
+        "class M:\n"
+        "    def training_step(self, params, batch, rng):\n"
+        "        out = {}\n"
+        "        for k in set(batch):\n"
+        "            out[k] = batch[k]\n"
+        "        return out\n")
+    assert rules_of(bad) == ["RLT206"]
+    good = lint(
+        "class M:\n"
+        "    def training_step(self, params, batch, rng):\n"
+        "        out = {}\n"
+        "        for k in sorted(set(batch)):\n"
+        "            out[k] = batch[k]\n"
+        "        return out\n")
+    assert good == []
+
+
+def test_set_comprehension_iteration_warns():
+    fs = lint(
+        "class M:\n"
+        "    def training_step(self, params, batch, rng):\n"
+        "        return [batch[k] for k in {'a', 'b'}]\n")
+    assert rules_of(fs) == ["RLT206"]
+
+
+# ---- RLT101 / RLT103 mesh-axis literals ----------------------------------
+
+
+def test_partition_spec_typo_fires_anywhere():
+    fs = lint(
+        "from jax.sharding import PartitionSpec as P\n"
+        "SPEC = P('fdsp', None)\n")
+    assert rules_of(fs) == ["RLT101"]
+    assert "fdsp" in fs[0].message
+
+
+def test_partition_spec_duplicate_axis_fires():
+    fs = lint(
+        "from jax.sharding import PartitionSpec\n"
+        "SPEC = PartitionSpec('tensor', 'tensor')\n")
+    assert rules_of(fs) == ["RLT103"]
+
+
+def test_partition_spec_good_axes_quiet():
+    fs = lint(
+        "from jax.sharding import PartitionSpec as P\n"
+        "A = P('data', None)\n"
+        "B = P(('data', 'fsdp'), 'tensor')\n"
+        "C = P()\n")
+    assert fs == []
+
+
+def test_extra_axes_extend_vocabulary():
+    src = ("from jax.sharding import PartitionSpec as P\n"
+           "SPEC = P('stage', None)\n")
+    assert rules_of(lint_source(src, "<t>")) == ["RLT101"]
+    assert lint_source(src, "<t>", extra_axes=("stage",)) == []
+
+
+# ---- RLT001 + suppression ------------------------------------------------
+
+
+def test_parse_error_reported_not_raised():
+    fs = lint("def broken(:\n")
+    assert rules_of(fs) == ["RLT001"]
+
+
+def test_line_suppression():
+    fs = lint(
+        "class M:\n"
+        "    def training_step(self, params, batch, rng):\n"
+        "        print('x')  # rlt: disable=RLT204\n"
+        "        return 0\n")
+    assert fs == []
+
+
+def test_file_suppression():
+    fs = lint(
+        "# rlt: disable-file=RLT204\n"
+        "class M:\n"
+        "    def training_step(self, params, batch, rng):\n"
+        "        print('a')\n"
+        "        print('b')\n"
+        "        return 0\n")
+    assert fs == []
+
+
+def test_bare_suppression_disables_all_on_line():
+    fs = lint(
+        "import numpy as np\n"
+        "class M:\n"
+        "    def training_step(self, params, batch, rng):\n"
+        "        return np.asarray(batch)  # rlt: disable\n")
+    assert fs == []
+
+
+# ---- self-lint: the framework passes its own analyzer --------------------
+
+
+def test_bundled_models_and_ops_self_lint_clean():
+    """ISSUE-1 acceptance: llama, moe, and all of ops/ are clean under
+    the default severity (every finding, not only errors)."""
+    targets = [
+        os.path.join(PKG, "models", "llama.py"),
+        os.path.join(PKG, "models", "moe.py"),
+        os.path.join(PKG, "ops"),
+    ]
+    findings = lint_paths(targets)
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_whole_package_self_lint_clean():
+    """The bar format.sh enforces: the entire package lints clean."""
+    findings = lint_paths([PKG])
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_tpumodule_lint_classmethod():
+    from ray_lightning_tpu.models.llama import LlamaModule
+
+    assert LlamaModule.lint() == []

@@ -57,6 +57,60 @@ def test_backend_retry_env_knobs(monkeypatch):
     assert bench._env_float("RLT_BENCH_INIT_BACKOFF_S", 9.0) == 9.0
 
 
+def test_backend_retry_wall_clock_cap(monkeypatch):
+    """RLT_BENCH_MAX_WAIT caps the retry loop's TOTAL wall-clock: the
+    exponential ladder alone (20+40+...+320s) outlived the harness
+    timeout in round 5 (BENCH_r05 rc=124 — no JSON at all). With the cap
+    the loop gives up early with a BackendUnavailable instead of
+    sleeping past the budget."""
+    import time as _time
+
+    import jax
+
+    calls = {"n": 0}
+
+    def dead():
+        calls["n"] += 1
+        raise RuntimeError("UNAVAILABLE")
+
+    monkeypatch.setattr(jax, "devices", dead)
+    t0 = _time.monotonic()
+    with pytest.raises(bench.BackendUnavailable, match="RLT_BENCH_MAX_WAIT"):
+        bench._backend_with_retry(tries=50, base_backoff=0.2,
+                                  max_wait_s=0.3)
+    assert _time.monotonic() - t0 < 5.0
+    assert calls["n"] < 50  # the cap cut the ladder short
+
+    # env knob spells the same cap
+    monkeypatch.setenv("RLT_BENCH_MAX_WAIT", "0.3")
+    monkeypatch.setenv("RLT_BENCH_INIT_RETRIES", "50")
+    monkeypatch.setenv("RLT_BENCH_INIT_BACKOFF_S", "0.2")
+    with pytest.raises(bench.BackendUnavailable, match="exhausted"):
+        bench._backend_with_retry()
+
+
+def test_backend_unavailable_emits_skipped_json(monkeypatch, capsys):
+    """The ISSUE-1 contract: a backend that never comes up yields ONE
+    parseable JSON line carrying {"skipped": "backend unavailable"} (so
+    the recorder can tell an environmental skip from a failure on
+    merit), exit 3, never a hang or a bare traceback."""
+
+    def unavailable():
+        raise bench.BackendUnavailable(
+            "jax backend unavailable after 6 attempts: UNAVAILABLE")
+
+    monkeypatch.setattr(bench, "_backend_with_retry", unavailable)
+    monkeypatch.setenv("RLT_BENCH_WATCHDOG_S", "0")
+    with pytest.raises(SystemExit) as exc_info:
+        bench.main()
+    assert exc_info.value.code == 3
+    obj = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert obj["skipped"] == "backend unavailable"
+    assert obj["value"] == 0.0
+    assert "UNAVAILABLE" in obj["error"]
+    assert obj["metric"] == "llama_0.5b_train_tokens_per_sec_per_chip"
+
+
 def test_backend_init_failure_emits_structured_error(monkeypatch, capsys):
     """main() on an unavailable backend: exit 3 and ONE JSON line with
     an 'error' naming the exception — the watchdog guards hangs, this

@@ -1,0 +1,126 @@
+"""`python -m ray_lightning_tpu lint` CLI contract tests (ISSUE-1
+acceptance): exit 0 on the bundled models with no TPU present, exit
+non-zero — with rule ids in --json output — on a fixture module carrying
+a mesh-axis typo and a training_step host transfer. One subprocess smoke
+proves the real `python -m` path; the rest run in-process."""
+import json
+import os
+import subprocess
+import sys
+
+from ray_lightning_tpu.__main__ import main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MODELS = os.path.join(REPO, "ray_lightning_tpu", "models")
+
+BAD_FIXTURE = """\
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+SPECS = {"w": P("fdsp", None)}       # mesh-axis typo (RLT101)
+
+
+class FixtureModule:
+    def training_step(self, params, batch, rng):
+        loss = (params["w"] * batch["x"]).sum()
+        host = np.asarray(loss)      # host transfer (RLT201)
+        return loss
+"""
+
+
+def test_lint_bundled_models_exit_0(capsys):
+    assert main(["lint", MODELS]) == 0
+    out = capsys.readouterr().out
+    assert "0 finding(s)" in out
+
+
+def test_lint_bad_fixture_nonzero_with_rule_ids_json(tmp_path, capsys):
+    bad = tmp_path / "bad_module.py"
+    bad.write_text(BAD_FIXTURE)
+    rc = main(["lint", str(bad), "--json"])
+    report = json.loads(capsys.readouterr().out.strip())
+    assert rc == 1
+    assert report["ok"] is False
+    rules = {f["rule"] for f in report["findings"]}
+    assert {"RLT101", "RLT201"} <= rules
+    sym = {f.get("symbol") for f in report["findings"]}
+    assert "FixtureModule.training_step" in sym
+
+
+def test_lint_json_before_subcommand(tmp_path, capsys):
+    """--json BEFORE the subcommand must work (same namespace-sharing
+    contract as the plan subparser)."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(BAD_FIXTURE)
+    rc = main(["--json", "lint", str(bad)])
+    report = json.loads(capsys.readouterr().out.strip())
+    assert rc == 1 and report["ok"] is False
+
+
+def test_lint_severity_and_fail_on_gates(tmp_path, capsys):
+    warn_only = tmp_path / "warn.py"
+    warn_only.write_text(
+        "class M:\n"
+        "    def training_step(self, params, batch, rng):\n"
+        "        print('x')\n"
+        "        return 0\n")
+    # default gate (error): warnings are reported but don't fail
+    rc = main(["lint", str(warn_only), "--json"])
+    report = json.loads(capsys.readouterr().out.strip())
+    assert rc == 0 and report["counts"]["warning"] == 1
+    # tightened gate fails on the warning
+    assert main(["lint", str(warn_only), "--fail-on", "warning"]) == 1
+    capsys.readouterr()
+    # severity filter hides it entirely
+    rc = main(["lint", str(warn_only), "--severity", "error", "--json"])
+    report = json.loads(capsys.readouterr().out.strip())
+    assert rc == 0 and report["findings"] == []
+
+
+def test_lint_disable_drops_rule(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(BAD_FIXTURE)
+    rc = main(["lint", str(bad), "--disable", "RLT101,RLT201", "--json"])
+    report = json.loads(capsys.readouterr().out.strip())
+    assert rc == 0 and report["findings"] == []
+
+
+def test_lint_dotted_module_target(capsys):
+    assert main(["lint", "ray_lightning_tpu.models.llama"]) == 0
+    assert "0 finding(s)" in capsys.readouterr().out
+
+
+def test_lint_missing_target_exit_2(capsys):
+    rc = main(["lint", "no/such/path.py", "--json"])
+    report = json.loads(capsys.readouterr().out.strip())
+    assert rc == 2 and "no such" in report["error"]
+
+
+def test_lint_list_rules(capsys):
+    assert main(["lint", "--list-rules", "--json"]) == 0
+    rules = json.loads(capsys.readouterr().out.strip())
+    assert "RLT101" in rules and "RLT201" in rules
+
+
+def test_lint_cli_subprocess_smoke(tmp_path):
+    """The real `python -m ray_lightning_tpu lint --json` path, on CPU
+    with JAX_PLATFORMS pinned — the acceptance-criteria invocation."""
+    bad = tmp_path / "bad_module.py"
+    bad.write_text(BAD_FIXTURE)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": REPO + os.pathsep + os.environ.get(
+               "PYTHONPATH", "")}
+    ok = subprocess.run(
+        [sys.executable, "-m", "ray_lightning_tpu", "lint", MODELS,
+         "--json"],
+        capture_output=True, text=True, timeout=180, env=env, cwd=REPO)
+    assert ok.returncode == 0, ok.stderr[-2000:]
+    assert json.loads(ok.stdout.strip())["ok"] is True
+
+    fail = subprocess.run(
+        [sys.executable, "-m", "ray_lightning_tpu", "lint", str(bad),
+         "--json"],
+        capture_output=True, text=True, timeout=180, env=env, cwd=REPO)
+    assert fail.returncode == 1, fail.stderr[-2000:]
+    report = json.loads(fail.stdout.strip())
+    assert {"RLT101", "RLT201"} <= {f["rule"] for f in report["findings"]}

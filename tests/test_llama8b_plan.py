@@ -44,6 +44,52 @@ def _batch_struct(batch, seq):
     return {"tokens": np.zeros((batch, seq + 1), np.int32)}
 
 
+def test_hbm_bytes_override_accepts_unknown_hardware():
+    """ISSUE-1 satellite: an unknown device_kind errors helpfully
+    (listing known kinds — never a bare KeyError), and an explicit
+    hbm_bytes override plans hardware the table doesn't know."""
+    from ray_lightning_tpu.parallel.plan import hbm_bytes_for_kind
+
+    with pytest.raises(ValueError, match="known"):
+        hbm_bytes_for_kind("TPU v99")
+    with pytest.raises(ValueError, match="positive"):
+        hbm_bytes_for_kind("TPU v99", hbm_bytes=0)
+    assert hbm_bytes_for_kind("TPU v99", 7 * GIB) == 7 * GIB
+    assert hbm_bytes_for_kind("TPU v5p") == HBM_BYTES_BY_KIND["TPU v5p"]
+
+    cfg = LlamaConfig.tiny()
+    plan = plan_train_memory(
+        LlamaModule(cfg), ShardedMesh(fsdp=8), n_devices=8,
+        example_batch=_batch_struct(8, 256),
+        device_kind="research-chip-x1",
+        hbm_bytes_per_device=8 * GIB,
+    )
+    assert plan.hbm_bytes_per_device == 8 * GIB
+    assert plan.fits
+
+
+def test_plan_cli_hbm_bytes_override(capsys):
+    """--hbm-bytes flows through the plan subcommand, unlocking
+    free-form --device-kind strings."""
+    import json
+
+    from ray_lightning_tpu.__main__ import main
+
+    rc = main(["plan", "--preset", "tiny", "--fsdp", "8", "--batch", "8",
+               "--seq", "128", "--device-kind", "research-chip-x1",
+               "--hbm-bytes", str(8 * GIB), "--json"])
+    info = json.loads(capsys.readouterr().out.strip())
+    assert rc == 0 and info["fits"] is True
+    assert info["budget_bytes"] == int(8 * GIB * 0.9)
+
+    # without the override the unknown kind is a structured exit-2 error
+    rc = main(["plan", "--preset", "tiny", "--fsdp", "8", "--batch", "8",
+               "--seq", "128", "--device-kind", "research-chip-x1",
+               "--json"])
+    info = json.loads(capsys.readouterr().out.strip())
+    assert rc == 2 and "research-chip-x1" in info["error"]
+
+
 def test_8b_fits_v5p_64_under_fsdp():
     """The north-star plan: Llama-3-8B, FSDP over 64 v5p chips,
     global batch 64 x S=8192."""
