@@ -22,3 +22,9 @@ fi
 # examples/ ship user-facing step code, so they are held to the same bar.
 JAX_PLATFORMS=cpu python -m ray_lightning_tpu lint \
     ray_lightning_tpu examples bench.py __graft_entry__.py
+
+# tracecheck gate: the flagship Llama-8B v5p-64 step must audit clean at
+# the jaxpr level (no implicit resharding, no ring deadlocks, peak HBM
+# within budget) — docs/STATIC_ANALYSIS.md "tracecheck". CPU-only.
+JAX_PLATFORMS=cpu python -m ray_lightning_tpu trace llama3-8b \
+    --topo v5p-64 --json --fail-on error > /dev/null

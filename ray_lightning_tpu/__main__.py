@@ -88,6 +88,56 @@ def _plan_invalid(msg: str, as_json: bool) -> int:
     return 2
 
 
+def _plan_trace_section(args, module_factory, strategy_factory,
+                        n_devices: int, global_batch: int):
+    """tracecheck the planned step (jaxpr-level collective/HBM audit,
+    analysis/tracecheck.py) — the plan's byte math says whether the
+    weights FIT; this section says what the step will DO: ICI bytes and
+    estimated peak HBM. Degrades to a trace_error field rather than
+    failing the plan (the plan verdict must survive an audit bug)."""
+    import numpy as np
+
+    try:
+        from ray_lightning_tpu.analysis.costmodel import topology_for_kind
+        from ray_lightning_tpu.analysis.tracecheck import audit_step
+
+        topo = topology_for_kind(args.device_kind, n_devices,
+                                 hbm_bytes=args.hbm_bytes)
+        report = audit_step(
+            module_factory(), strategy_factory(),
+            {"tokens": np.zeros((global_batch, args.seq + 1), np.int32)},
+            topology=topo, label=f"{args.preset} plan")
+        counts = {"error": 0, "warning": 0, "note": 0}
+        for f in report.findings:
+            counts[f.severity] += 1
+        return {
+            "ici_bytes_per_step": report.ici_bytes_per_step,
+            "ici_time_us": round(report.ici_time_us, 1),
+            "peak_hbm_bytes": report.peak_hbm_bytes,
+            "hbm_budget_bytes": report.hbm_budget_bytes,
+            "fits": report.fits,
+            "finding_counts": counts,
+            "findings": [f.to_dict() for f in report.findings],
+        }
+    except Exception as exc:  # noqa: BLE001 — advisory section only
+        return {"trace_error": f"{type(exc).__name__}: {str(exc)[:300]}"}
+
+
+def _print_trace_section(trace: dict) -> None:
+    if "trace_error" in trace:
+        print(f"tracecheck: unavailable ({trace['trace_error']})")
+        return
+    gib = 1024**3
+    print(f"tracecheck: ICI {trace['ici_bytes_per_step'] / gib:.2f} "
+          f"GiB/step (~{trace['ici_time_us'] / 1e3:.1f} ms serialized), "
+          f"est. peak HBM {trace['peak_hbm_bytes'] / gib:.2f} GiB vs "
+          f"budget {trace['hbm_budget_bytes'] / gib:.2f} GiB "
+          f"({'fits' if trace['fits'] else 'DOES NOT FIT'})")
+    for f in trace["findings"]:
+        print(f"  {f['severity']} {f['rule']} ({f['name']}): "
+              f"{f['message']}")
+
+
 def run_plan(args) -> int:
     import numpy as np
 
@@ -169,12 +219,22 @@ def run_plan(args) -> int:
                 "fits": local >= 1,
                 "summary": summary,
             }
+            trace = None
+            if local >= 1 and not args.no_trace:
+                trace = _plan_trace_section(
+                    args, _module,
+                    lambda: ShardedMesh(data=args.data, fsdp=args.fsdp,
+                                        tensor=args.tensor),
+                    n_devices, local * dp)
+                result["trace"] = trace
             if args.as_json:
                 print(json.dumps(result))
             else:
                 print(f"max batch: {local}/device x dp {dp} = "
                       f"{local * dp} global")
                 print(summary)
+                if trace is not None:
+                    _print_trace_section(trace)
             return 0 if local >= 1 else 1
         plan = plan_train_memory(
             _module(),
@@ -191,17 +251,29 @@ def run_plan(args) -> int:
     except ValueError as exc:
         # a mesh the strategy rejects, a planner refusal — same contract
         return _plan_invalid(str(exc), args.as_json)
+    trace = None
+    if not args.no_trace:
+        trace = _plan_trace_section(
+            args, _module,
+            lambda: ShardedMesh(data=args.data, fsdp=args.fsdp,
+                                tensor=args.tensor),
+            n_devices, args.batch)
     if args.as_json:
-        print(json.dumps({
+        out = {
             "mesh": plan.mesh_axes,
             "n_devices": plan.n_devices,
             "per_device_bytes": plan.per_device_total,
             "budget_bytes": plan.budget,
             "fits": plan.fits,
             "summary": plan.summary(),
-        }))
+        }
+        if trace is not None:
+            out["trace"] = trace
+        print(json.dumps(out))
     else:
         print(plan.summary())
+        if trace is not None:
+            _print_trace_section(trace)
     return 0 if plan.fits else 1
 
 
@@ -249,14 +321,23 @@ def main(argv=None) -> int:
     # `--json` given before the subcommand
     plan_p.add_argument("--json", action="store_true", dest="as_json",
                         default=argparse.SUPPRESS)
-    from ray_lightning_tpu.analysis.cli import add_lint_parser, run_lint
+    plan_p.add_argument("--no-trace", action="store_true",
+                        help="skip the tracecheck section (the "
+                             "jaxpr-level collective/HBM audit of the "
+                             "planned step)")
+    from ray_lightning_tpu.analysis.cli import (
+        add_lint_parser, add_trace_parser, run_lint, run_trace,
+    )
 
     add_lint_parser(sub)
+    add_trace_parser(sub)
     args = p.parse_args(argv)
     if args.cmd == "plan":
         return run_plan(args)
     if args.cmd == "lint":
         return run_lint(args)
+    if args.cmd == "trace":
+        return run_trace(args)
     info = collect(probe=args.probe)
     if args.as_json:
         print(json.dumps(info))

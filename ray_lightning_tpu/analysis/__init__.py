@@ -13,8 +13,20 @@ Two zero-hardware engines sharing one Finding/rule vocabulary
     host transfers, Python RNG/wallclock/print, unhashable static args,
     unordered iteration — plus mesh-axis typos anywhere.
 
-CLI: `python -m ray_lightning_tpu lint [path|module]` (analysis/cli.py).
+A third engine, tracecheck (`audit_step`, tracecheck.py), audits the
+REAL jitted train step at the jaxpr level: the collective schedule with
+a per-topology ICI cost model (costmodel.py), implicit-resharding
+findings (RLT301), a liveness peak-HBM estimate vs the chip budget
+(RLT302), and ring/pipeline ppermute schedule checks (RLT303).
+
+CLI: `python -m ray_lightning_tpu lint [path|module]` and
+`python -m ray_lightning_tpu trace <example|preset|module:factory>
+[--topo v5p-64]` (analysis/cli.py).
 """
+from ray_lightning_tpu.analysis.costmodel import (  # noqa: F401
+    ICI_SPECS, CollectiveCost, Topology, collective_cost, parse_topology,
+    topology_for_kind,
+)
 from ray_lightning_tpu.analysis.findings import (  # noqa: F401
     RULES, SEVERITY_RANK, Finding, Rule, max_severity, meets,
 )
@@ -25,10 +37,18 @@ from ray_lightning_tpu.analysis.plan_checker import (  # noqa: F401
     check_donation, check_opt_state_dtypes, check_param_specs, check_plan,
     spec_findings,
 )
+from ray_lightning_tpu.analysis.tracecheck import (  # noqa: F401
+    CollectiveEvent, TraceReport, audit_step, check_permutation,
+    trace_step,
+)
 
 __all__ = [
     "RULES", "SEVERITY_RANK", "Finding", "Rule", "max_severity", "meets",
     "KNOWN_MESH_AXES", "TRACED_STEP_HOOKS", "lint_paths", "lint_source",
     "check_donation", "check_opt_state_dtypes", "check_param_specs",
     "check_plan", "spec_findings",
+    "ICI_SPECS", "CollectiveCost", "Topology", "collective_cost",
+    "parse_topology", "topology_for_kind",
+    "CollectiveEvent", "TraceReport", "audit_step", "check_permutation",
+    "trace_step",
 ]

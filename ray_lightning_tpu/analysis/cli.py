@@ -1,13 +1,19 @@
-"""`python -m ray_lightning_tpu lint` — the shardcheck CLI.
+"""`python -m ray_lightning_tpu lint` / `... trace` — the shardcheck
+and tracecheck CLIs.
 
-Sibling of the doctor/plan subcommands (`__main__.py`): zero hardware,
-runs anywhere Python runs. Targets are files, directories (recursed), or
-importable dotted module names (resolved to their source, never
-executed beyond the import machinery's parent-package resolution).
+Siblings of the doctor/plan subcommands (`__main__.py`): zero hardware,
+run anywhere Python runs. `lint` targets are files, directories
+(recursed), or importable dotted module names (resolved to their
+source, never executed beyond the import machinery's parent-package
+resolution). `trace` targets are bundled example names
+(`llama_fsdp_example.py`), the `llama3-8b` preset, or a
+`pkg.mod:factory` callable returning ``(module, strategy,
+example_batch)`` — the factory IS imported and called.
 
-Exit status: 0 clean (no finding at/above --fail-on), 1 findings at or
-above the gate, 2 invalid invocation (missing path, unresolvable
-module). With --json the report is ONE machine-readable JSON object.
+Exit status (both): 0 clean (no finding at/above --fail-on), 1 findings
+at or above the gate, 2 invalid invocation (missing path, unresolvable
+module/target). With --json the report is ONE machine-readable JSON
+object.
 """
 from __future__ import annotations
 
@@ -144,3 +150,205 @@ def run_lint(args) -> int:
 def format_findings(findings: List[Finding]) -> str:
     """Convenience for embedding reports in exceptions/tests."""
     return "\n".join(f.format() for f in findings)
+
+
+# --------------------------------------------------------------------------
+# trace — the tracecheck CLI
+# --------------------------------------------------------------------------
+#
+# Every bundled example has a builder that reconstructs its (module,
+# strategy, example batch) triple SIZED FOR THE TOPOLOGY, so
+# `trace examples/llama_fsdp_example.py --topo v5p-64` audits the same
+# step the example would compile on that slice — without running the
+# example (examples parse argv, build trainers, and train).
+
+
+def _build_llama_fsdp(topo):
+    import numpy as np
+
+    from ray_lightning_tpu.models.llama import LlamaConfig, LlamaModule
+    from ray_lightning_tpu.parallel.strategy import ShardedMesh
+
+    n = topo.n_devices
+    if n >= 16:
+        # the BASELINE.json north-star config: 8B, remat+scan+fused CE,
+        # flash attention (the program the TPU actually runs), one
+        # 8192-token row per device
+        cfg = LlamaConfig.llama3_8b(
+            remat=True, scan_layers=True, fused_ce=True, use_flash=True,
+            max_seq_len=8192)
+        batch, seq = n, 8192
+        label = f"llama3-8b FSDP({n})"
+    else:
+        cfg = LlamaConfig.tiny(use_flash=True)
+        batch, seq = 2 * n, min(256, cfg.max_seq_len)
+        label = f"llama-tiny FSDP({n})"
+    return (LlamaModule(cfg), ShardedMesh(fsdp=n),
+            {"tokens": np.zeros((batch, seq + 1), np.int32)}, label)
+
+
+def _build_mlp(features, num_classes, in_dim, label):
+    def build(topo):
+        import numpy as np
+
+        from ray_lightning_tpu.models.mlp import MLPClassifier
+        from ray_lightning_tpu.parallel.strategy import DataParallel
+
+        n = topo.n_devices
+        B = 8 * n
+        return (MLPClassifier(features=features, num_classes=num_classes),
+                DataParallel(),
+                {"x": np.zeros((B, in_dim), np.float32),
+                 "y": np.zeros((B,), np.int32)},
+                f"{label} DataParallel({n})")
+    return build
+
+
+def _build_cifar_resnet(topo):
+    import numpy as np
+
+    from ray_lightning_tpu.models.resnet import ResNetModule
+    from ray_lightning_tpu.parallel.strategy import DataParallel
+
+    n = topo.n_devices
+    B = 8 * n
+    return (ResNetModule(variant="resnet18", num_classes=10),
+            DataParallel(),
+            {"x": np.zeros((B, 32, 32, 3), np.float32),
+             "y": np.zeros((B,), np.int32)},
+            f"resnet18 DataParallel({n})")
+
+
+def _build_bert_finetune(topo):
+    import numpy as np
+
+    from ray_lightning_tpu.models.bert import (
+        BertClassifierModule, BertConfig,
+    )
+    from ray_lightning_tpu.parallel.strategy import DataParallel
+
+    n = topo.n_devices
+    B, S = 4 * n, 128
+    cfg = BertConfig.tiny(dropout=0.0)
+    return (BertClassifierModule(cfg, num_classes=2), DataParallel(),
+            {"input_ids": np.zeros((B, S), np.int32),
+             "labels": np.zeros((B,), np.int32)},
+            f"bert-tiny DataParallel({n})")
+
+
+_TRACE_BUILDERS = {
+    "llama_fsdp_example.py": _build_llama_fsdp,
+    "llama3-8b": _build_llama_fsdp,
+    "mnist_dp_example.py": _build_mlp((128, 256), 10, 784, "mnist-mlp"),
+    "mnist_sweep_example.py": _build_mlp((128, 256), 10, 784,
+                                         "mnist-sweep-mlp"),
+    "pod_launch_example.py": _build_mlp((64,), 4, 16, "pod-mlp"),
+    "cifar_resnet_example.py": _build_cifar_resnet,
+    "bert_finetune_example.py": _build_bert_finetune,
+}
+
+
+def add_trace_parser(sub) -> None:
+    """Attach the `trace` subparser (argparse) to `sub`."""
+    p = sub.add_parser(
+        "trace",
+        help="audit a strategy's REAL jitted train step at the jaxpr "
+             "level: collective schedule + ICI cost, implicit "
+             "resharding, ring checks, peak-HBM estimate (no TPU)")
+    p.add_argument(
+        "target",
+        help="a bundled example (examples/llama_fsdp_example.py), the "
+             "'llama3-8b' preset, or pkg.mod:factory returning "
+             "(module, strategy, example_batch)")
+    p.add_argument(
+        "--topo", default="v5p-8",
+        help="target topology <family>-<chips>, e.g. v5p-64 "
+             "(families: v3 v4 v5e v5p v6e cpu)")
+    p.add_argument(
+        "--hbm-bytes", type=int, default=None,
+        help="per-device usable HBM override in bytes")
+    p.add_argument(
+        "--severity", choices=("note", "warning", "error"),
+        default="note", help="minimum severity to report")
+    p.add_argument(
+        "--fail-on", choices=("note", "warning", "error"),
+        default="error",
+        help="exit 1 when any finding is at/above this severity")
+    p.add_argument("--disable", default="",
+                   help="comma-separated rule ids to drop (e.g. RLT302)")
+    # same namespace-sharing contract as the plan/lint subparsers
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   default=argparse.SUPPRESS)
+
+
+def resolve_trace_target(target: str, topo):
+    """Resolve a trace target to ``(module, strategy, batch, label)``.
+    Returns None when the target is not recognizable (exit-2 path)."""
+    base = os.path.basename(target)
+    builder = _TRACE_BUILDERS.get(base) or _TRACE_BUILDERS.get(target)
+    if builder is not None:
+        return builder(topo)
+    if ":" in target and os.sep not in target:
+        mod_name, _, fn_name = target.partition(":")
+        import importlib
+
+        try:
+            factory = getattr(importlib.import_module(mod_name), fn_name)
+        except (ImportError, AttributeError):
+            return None
+        built = factory()
+        if isinstance(built, dict):
+            return (built["module"], built["strategy"], built["batch"],
+                    built.get("label", target))
+        module, strategy, batch = built[:3]
+        label = built[3] if len(built) > 3 else target
+        return module, strategy, batch, label
+    return None
+
+
+def run_trace(args) -> int:
+    as_json = getattr(args, "as_json", False)
+    from ray_lightning_tpu.analysis.costmodel import parse_topology
+    from ray_lightning_tpu.analysis.tracecheck import audit_step
+
+    def invalid(msg: str) -> int:
+        if as_json:
+            print(json.dumps({"error": msg}))
+        else:
+            print(f"error: {msg}", file=sys.stderr)
+        return 2
+
+    try:
+        topo = parse_topology(args.topo, hbm_bytes=args.hbm_bytes)
+    except ValueError as exc:
+        return invalid(str(exc))
+    try:
+        built = resolve_trace_target(args.target, topo)
+    except Exception as exc:  # noqa: BLE001 — a factory that raises is
+        # an invalid invocation, not a finding
+        return invalid(f"building {args.target!r} failed: "
+                       f"{type(exc).__name__}: {exc}")
+    if built is None:
+        return invalid(
+            f"unknown trace target {args.target!r}; use a bundled "
+            f"example ({sorted(set(_TRACE_BUILDERS) - {'llama3-8b'})}), "
+            "the 'llama3-8b' preset, or pkg.mod:factory")
+    module, strategy, batch, label = built
+
+    report = audit_step(module, strategy, batch, topology=topo,
+                        label=label)
+    disabled = {r.strip() for r in args.disable.split(",") if r.strip()}
+    min_rank = SEVERITY_RANK[args.severity]
+    findings = [f for f in report.findings
+                if f.rule not in disabled
+                and SEVERITY_RANK[f.severity] >= min_rank]
+    report.findings = findings
+    gate_hit = meets(findings, args.fail_on)
+    if as_json:
+        print(json.dumps({"ok": not gate_hit, "fail_on": args.fail_on,
+                          **report.to_dict()}))
+    else:
+        print(report.summary())
+        if gate_hit:
+            print(f"— failing (gate: {args.fail_on})")
+    return 1 if gate_hit else 0

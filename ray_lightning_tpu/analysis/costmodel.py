@@ -1,0 +1,173 @@
+"""Per-topology interconnect/HBM cost model for tracecheck.
+
+tracecheck (analysis/tracecheck.py) turns a jitted train step into a
+collective schedule; this module turns that schedule into bytes-on-wire
+and a latency estimate for a NAMED topology ("v5p-64") — zero hardware,
+so the numbers are a *model*, not a measurement. The HBM side reuses the
+planner's hardware table (`parallel.plan.hbm_bytes_for_kind`), keeping
+one source of truth for per-chip memory; the ICI side adds the
+bandwidth/latency figures the planner never needed.
+
+Model assumptions (documented in docs/STATIC_ANALYSIS.md):
+
+  * bandwidth figures are the PUBLISHED aggregate ICI bytes/s per chip
+    (all links combined). Ring algorithms use every link of the group's
+    torus dimension, so charging the aggregate is the optimistic bound;
+    contention with other collectives is not modeled;
+  * collective wire cost per chip follows the standard ring algebra over
+    group size n: all_gather / reduce_scatter move (n-1)/n of the full
+    payload, an all_reduce (psum) is reduce_scatter + all_gather =
+    2(n-1)/n, a ppermute moves exactly its payload one hop, all_to_all
+    moves (n-1)/n;
+  * latency = hops x per-hop ICI latency + wire_bytes / bandwidth, with
+    hops = n-1 for ring collectives and 1 for a neighbor permute;
+  * DCN (multi-slice) is out of scope: tracecheck audits one slice, the
+    mesh layer already refuses meshes whose non-data axes span slices
+    (parallel/mesh.py order_devices_for_slices).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Dict, Mapping, Optional, Tuple
+
+from ray_lightning_tpu.parallel.plan import hbm_bytes_for_kind
+
+__all__ = [
+    "Topology", "CollectiveCost", "ICI_SPECS", "parse_topology",
+    "topology_for_kind", "collective_cost",
+]
+
+#: ICI spec sheet per device family: (device_kind for the HBM table,
+#: aggregate ICI GB/s per chip, per-hop latency in microseconds).
+#: Bandwidths are the public per-chip interconnect figures (v4 2400
+#: Gbps, v5e 1600, v5p 4800, v6e 3584); "cpu" is the CI pseudo-family
+#: (loopback, spec-sheet-free) so tests and laptops can run the same
+#: code path with an explicit hbm override.
+ICI_SPECS: Dict[str, Tuple[str, float, float]] = {
+    "v3": ("TPU v3", 280.0, 1.5),
+    "v4": ("TPU v4", 300.0, 1.0),
+    "v5e": ("TPU v5e", 200.0, 1.0),
+    "v5litepod": ("TPU v5 lite", 200.0, 1.0),
+    "v5p": ("TPU v5p", 600.0, 1.0),
+    "v6e": ("TPU v6e", 448.0, 1.0),
+    "cpu": ("cpu", 10.0, 10.0),
+}
+
+#: device_kind -> family, for topology_for_kind (the reverse lookup of
+#: ICI_SPECS' first column)
+_KIND_TO_FAMILY = {kind: fam for fam, (kind, _, _) in ICI_SPECS.items()}
+
+#: fallback HBM for families the planner table doesn't know (the "cpu"
+#: pseudo-family): enough to trace, small enough that a real model's
+#: HBM-OVERCOMMIT check still exercises on CI
+_CPU_HBM_BYTES = 16 * 1024**3
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """One named slice: chip kind + count + interconnect figures."""
+
+    name: str             # e.g. "v5p-64"
+    device_kind: str      # PJRT device_kind string, keys the HBM table
+    n_devices: int
+    ici_gbps: float       # aggregate ICI bandwidth per chip, GB/s
+    ici_hop_latency_us: float
+    hbm_bytes: int        # usable HBM per chip
+
+    @property
+    def hbm_gib(self) -> float:
+        return self.hbm_bytes / 1024**3
+
+    def describe(self) -> str:
+        return (f"{self.name}: {self.n_devices}x {self.device_kind} "
+                f"({self.hbm_gib:.0f} GiB HBM, {self.ici_gbps:.0f} GB/s "
+                "ICI per chip)")
+
+
+def parse_topology(name: str, *,
+                   hbm_bytes: Optional[int] = None) -> Topology:
+    """``"v5p-64"`` -> a Topology. The family keys ICI_SPECS; the chip
+    count is the part after the dash. Unknown families raise listing the
+    known ones (same first-contact contract as hbm_bytes_for_kind)."""
+    m = re.fullmatch(r"([a-z0-9]+?)-(\d+)", name.strip().lower())
+    if not m:
+        raise ValueError(
+            f"cannot parse topology {name!r}; expected <family>-<chips> "
+            f"like 'v5p-64' (families: {sorted(ICI_SPECS)})")
+    family, count = m.group(1), int(m.group(2))
+    if family not in ICI_SPECS:
+        raise ValueError(
+            f"unknown topology family {family!r} (known: "
+            f"{sorted(ICI_SPECS)}); pass hbm_bytes= and use "
+            "topology_for_kind for other hardware")
+    if count < 1:
+        raise ValueError(f"topology {name!r} must have >= 1 chip")
+    kind, gbps, lat = ICI_SPECS[family]
+    if hbm_bytes is None:
+        try:
+            hbm_bytes = hbm_bytes_for_kind(kind)
+        except ValueError:  # the "cpu" pseudo-family
+            hbm_bytes = _CPU_HBM_BYTES
+    return Topology(name=name, device_kind=kind, n_devices=count,
+                    ici_gbps=gbps, ici_hop_latency_us=lat,
+                    hbm_bytes=int(hbm_bytes))
+
+
+def topology_for_kind(device_kind: str, n_devices: int, *,
+                      hbm_bytes: Optional[int] = None) -> Topology:
+    """Topology from a PJRT ``device_kind`` string (the plan CLI's
+    --device-kind vocabulary) instead of a family-dash-count name.
+    Unknown kinds get the cpu pseudo-family's conservative ICI figures —
+    the HBM side still honors ``hbm_bytes`` or the planner table."""
+    family = _KIND_TO_FAMILY.get(device_kind, "cpu")
+    _, gbps, lat = ICI_SPECS[family]
+    if hbm_bytes is None:
+        try:
+            hbm_bytes = hbm_bytes_for_kind(device_kind)
+        except ValueError:
+            hbm_bytes = _CPU_HBM_BYTES
+    return Topology(name=f"{family}-{n_devices}", device_kind=device_kind,
+                    n_devices=n_devices, ici_gbps=gbps,
+                    ici_hop_latency_us=lat, hbm_bytes=int(hbm_bytes))
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveCost:
+    wire_bytes: int   # bytes each chip puts on ICI for this collective
+    time_us: float    # ring-model latency estimate
+
+
+def collective_cost(
+    kind: str,
+    payload_bytes: int,
+    axis_sizes: Mapping[str, int],
+    topo: Topology,
+) -> CollectiveCost:
+    """Ring-model wire bytes + latency for ONE collective.
+
+    ``payload_bytes`` is the per-chip payload the jaxpr shows: the local
+    operand bytes for psum/ppermute/all_to_all/reduce_scatter, and the
+    per-chip FULL (post-gather) bytes for all_gather. ``axis_sizes`` maps
+    the participating mesh axes to their sizes; the group size is their
+    product."""
+    n = max(1, math.prod(axis_sizes.values()))
+    if n == 1:
+        return CollectiveCost(0, 0.0)
+    frac = (n - 1) / n
+    if kind == "psum":
+        wire = 2.0 * payload_bytes * frac
+        hops = 2 * (n - 1)
+    elif kind in ("all_gather", "reduce_scatter", "all_to_all"):
+        wire = payload_bytes * frac
+        hops = n - 1
+    elif kind == "ppermute":
+        wire = float(payload_bytes)
+        hops = 1
+    else:  # pmax/pmin/pbroadcast and friends: all_reduce-shaped
+        wire = 2.0 * payload_bytes * frac
+        hops = 2 * (n - 1)
+    time_us = (wire / (topo.ici_gbps * 1e3)
+               + hops * topo.ici_hop_latency_us)
+    return CollectiveCost(int(wire), time_us)

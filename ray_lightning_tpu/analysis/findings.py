@@ -83,6 +83,24 @@ RULES: Dict[str, Rule] = {r.id: r for r in (
          "iteration over an unordered collection (set/vars()) while "
          "building traced structure; pytree order can differ across "
          "processes"),
+    # RLT3xx — the tracecheck engine (analysis/tracecheck.py): jaxpr-level
+    # audit of the REAL jitted train step. The uppercase aliases below are
+    # the vocabulary ISSUE/docs use in prose: RESHARD-IMPLICIT,
+    # HBM-OVERCOMMIT, RING-DEADLOCK.
+    Rule("RLT301", "reshard-implicit", "error",
+         "in/out sharding mismatch makes XLA insert a collective the "
+         "plan never asked for (an activation all-gather or a reshard "
+         "between mesh axes) — silent ICI traffic every step"),
+    Rule("RLT302", "hbm-overcommit", "error",
+         "the traced step's estimated peak HBM (params + opt state + "
+         "activation high-water mark) exceeds the target chip's budget; "
+         "the job will OOM at compile or at runtime"),
+    Rule("RLT303", "ring-deadlock", "error",
+         "a ppermute permutation is not a valid schedule (duplicate "
+         "source/destination, out-of-range rank, a full permutation "
+         "that is not a single cycle) or collective sequences diverge "
+         "across cond branches — SPMD ranks deadlock or exchange "
+         "garbage"),
 )}
 
 

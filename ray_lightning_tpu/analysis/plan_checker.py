@@ -176,23 +176,35 @@ def check_donation(
 
     Both arguments map leaf paths to ``(abstract_leaf, sharding)``
     pairs (sharding may be None when unsharded); outputs are consumed
-    at most once, mirroring XLA's aliasing rules."""
+    at most once, mirroring XLA's aliasing rules. Paths are FULL
+    pytree paths — nested dict/list opt-state leaves (custom optimizers
+    that stash slots in containers) keep their complete
+    ``opt_state/slots/1/...`` path in the finding, and a failed alias
+    names the nearest same-shape output so the dtype/sharding drift
+    that broke it is visible."""
     findings: List[Finding] = []
     pool: Dict[Tuple, int] = {}
-    for _, (leaf, sh) in output_named.items():
+    by_shape: Dict[Tuple, List[Tuple[str, Tuple]]] = {}
+    for opath, (leaf, sh) in output_named.items():
         key = _leaf_key(leaf, sh)
         pool[key] = pool.get(key, 0) + 1
+        by_shape.setdefault(key[0], []).append((opath, key))
     for path, (leaf, sh) in donated_named.items():
         key = _leaf_key(leaf, sh)
         if pool.get(key, 0) > 0:
             pool[key] -= 1
         else:
+            near = by_shape.get(key[0], [])
+            hint = (
+                f" Nearest same-shape output: {near[0][0]} (dtype "
+                f"{near[0][1][1]}, spec {near[0][1][2]})." if near
+                else " No output has this shape at all.")
             findings.append(Finding(
                 "RLT106",
                 f"donated input {path} (shape {key[0]}, dtype {key[1]}, "
                 f"spec {key[2]}) has no matching output buffer to alias "
                 "— the donation is wasted and peak memory exceeds the "
-                "plan by this buffer", symbol=path))
+                f"plan by this buffer.{hint}", symbol=path))
     return findings
 
 

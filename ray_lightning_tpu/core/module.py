@@ -204,6 +204,17 @@ class TpuModule:
             return []
         return lint_paths([src], **lint_kwargs)
 
+    def audit_step(self, strategy, example_batch, *, topology="v5p-8",
+                   **kw):
+        """tracecheck this module's real jitted train step under
+        ``strategy`` on ``topology`` — the jaxpr-level sibling of
+        `lint()` (source) and `analysis.check_plan` (specs): collective
+        schedule + ICI cost, implicit-resharding findings, ring checks,
+        and a peak-HBM estimate, all without touching hardware. See
+        `Strategy.audit_step`; the strategy instance is consumed."""
+        return strategy.audit_step(self, example_batch,
+                                   topology=topology, **kw)
+
     # Convenience: module(batch) runs predict with stored params.
     def __call__(self, *args, **kwargs):
         if self.params is None:

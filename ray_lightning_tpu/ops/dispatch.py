@@ -35,6 +35,24 @@ def force_xla():
         _forced.reset(token)
 
 
+@contextlib.contextmanager
+def force_pallas():
+    """Pin dispatch to the pallas kernel path for the current context.
+
+    The mirror image of `force_xla`, for tracecheck
+    (analysis/tracecheck.py): a CPU-host audit of a TPU step must trace
+    the program the TPU will actually run — with the flash kernel, the
+    giant [S, S] score matrix of the XLA reference path never exists, so
+    auditing the reference path would report an HBM peak the production
+    step does not have. Like force_xla this short-circuits the backend
+    probe, so no backend is ever initialized at trace time."""
+    token = _forced.set(True)
+    try:
+        yield
+    finally:
+        _forced.reset(token)
+
+
 def on_tpu() -> bool:
     """True when the default backend is a real TPU."""
     try:

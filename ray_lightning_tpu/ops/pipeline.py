@@ -33,6 +33,16 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ray_lightning_tpu.parallel.mesh import dp_axis_names
 
 
+def pipeline_perm(pipe: int) -> list[tuple[int, int]]:
+    """The GPipe stage-to-stage schedule: an OPEN chain (stage i sends to
+    i+1, no wrap-around hop — stage 0 never reads its recv, so the
+    longest link would carry dead payload; ppermute zero-fills unlisted
+    destinations). Schedule metadata for tracecheck (RLT303): a partial
+    permutation is legal precisely when, like this one, it has no
+    duplicate sources or destinations."""
+    return [(i, i + 1) for i in range(pipe - 1)]
+
+
 def gpipe_apply(
     stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
     stacked_params: Any,
@@ -119,9 +129,7 @@ def gpipe_apply(
             # open chain, not a ring: stage 0 never reads its recv, so the
             # wrap-around hop (the longest link) would carry dead payload;
             # ppermute zero-fills unlisted destinations
-            recv_next = jax.lax.ppermute(
-                y, axis_name, [(i, i + 1) for i in range(pipe - 1)]
-            )
+            recv_next = jax.lax.ppermute(y, axis_name, pipeline_perm(pipe))
             # the LAST stage emits microbatch t-(P-1)'s final activation
             out_idx = t - (pipe - 1)
             idx = jnp.clip(out_idx, 0, M - 1)

@@ -40,6 +40,16 @@ from ray_lightning_tpu.ops.attention import repeat_kv
 _NEG_INF = float("-inf")
 
 
+def ring_perm(axis_size: int) -> list[tuple[int, int]]:
+    """The canonical ring schedule: one single-cycle rotation, every rank
+    sends to its +1 neighbor. This is schedule METADATA as much as
+    implementation — tracecheck (analysis/tracecheck.py RLT303) validates
+    every traced ppermute against exactly the properties this shape
+    guarantees (no duplicate src/dst, full permutations form ONE cycle),
+    so the ring path and the auditor cannot drift apart."""
+    return [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+
 def _accum_block(q, k, v, o, m, l, *, q_off, kv_off, causal, scale):
     """One online-softmax update of (o, m, l) with a KV block.
 
@@ -95,7 +105,7 @@ def ring_attention_local(
     idx = jax.lax.axis_index(axis_name)
     q_off = idx * Sq
 
-    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    perm = ring_perm(axis_size)
 
     def body(t, carry):
         o, m, l, kb, vb = carry

@@ -230,6 +230,30 @@ class Strategy:
     def replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())
 
+    # ---- auditing --------------------------------------------------------
+
+    def audit_step(self, module, example_batch, *, topology="v5p-8",
+                   n_devices: Optional[int] = None,
+                   reserve_fraction: float = 0.10, label: str = ""):
+        """tracecheck this strategy's REAL jitted train step for
+        ``module`` on ``topology`` (a name like ``"v5p-64"`` or an
+        `analysis.costmodel.Topology`) — zero hardware, CPU-host safe.
+
+        Returns an `analysis.tracecheck.TraceReport`: the collective
+        schedule with ICI bytes/latency estimates, implicit-resharding
+        findings (RLT301), ring/pipeline schedule checks (RLT303), and
+        the peak-HBM estimate vs the chip budget (RLT302). Like
+        `plan_train_memory`/`check_plan`, the strategy instance is
+        CONSUMED (its mesh becomes abstract) — pass a fresh one, not
+        the instance a live Trainer holds."""
+        from ray_lightning_tpu.analysis.tracecheck import audit_step
+
+        return audit_step(
+            module, self, example_batch, topology=topology,
+            n_devices=n_devices, reserve_fraction=reserve_fraction,
+            label=label or f"{type(module).__name__} x "
+                           f"{type(self).__name__}")
+
     # ---- placement -------------------------------------------------------
 
     def shard_params(self, params) -> Any:

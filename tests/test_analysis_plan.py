@@ -14,8 +14,9 @@ from ray_lightning_tpu.analysis import (
     check_plan,
     spec_findings,
 )
+from ray_lightning_tpu.core.module import TpuModule
 from ray_lightning_tpu.models.llama import LlamaConfig, LlamaModule
-from ray_lightning_tpu.parallel.strategy import ShardedMesh
+from ray_lightning_tpu.parallel.strategy import FSDP, ShardedMesh
 
 MESH = {"data": 1, "pipe": 1, "fsdp": 8, "expert": 1, "seq": 1,
         "tensor": 1}
@@ -280,3 +281,76 @@ def test_strategy_quiet_on_wellformed_overlay(devices8):
     strategy.setup(module)
     shardings = strategy.param_shardings(module.params())
     assert jax.tree.leaves(shardings)
+
+
+class _NestedOptModule(TpuModule):
+    """Custom optimizer stashing param-shaped slots inside nested
+    dict/list containers — the donation audit must walk ALL of it and
+    report full pytree paths, not top-level keys (ISSUE-2 satellite)."""
+
+    def __init__(self, break_alias: bool = False):
+        super().__init__()
+        self.break_alias = break_alias
+
+    def init_params(self, rng, batch):
+        import jax.numpy as jnp
+
+        return {"dense": {"kernel": jnp.zeros((1024, 64), jnp.bfloat16)}}
+
+    def configure_model(self):
+        return None
+
+    def configure_optimizers(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        break_alias = self.break_alias
+
+        def init(params):
+            return {"slots": [
+                jax.tree.map(jnp.zeros_like, params),
+                jax.tree.map(lambda x: jnp.zeros(x.shape, jnp.float32),
+                             params),
+            ], "count": jnp.zeros((), jnp.int32)}
+
+        def update(grads, state, params=None):
+            slot1 = state["slots"][1]
+            if break_alias:
+                # dtype drift on the NESTED leaf: its donated f32
+                # buffer can no longer alias any output
+                slot1 = jax.tree.map(
+                    lambda x: x.astype(jnp.bfloat16), slot1)
+            return grads, {"slots": [state["slots"][0], slot1],
+                           "count": state["count"] + 1}
+
+        return optax.GradientTransformation(init, update)
+
+    def training_step(self, params, batch, rng):
+        import jax.numpy as jnp
+
+        return jnp.float32(0)
+
+
+def test_donation_audit_walks_nested_opt_state():
+    """Clean nested state: the only finding is the deliberate f32-nu
+    dtype widening (RLT105), reported with the FULL nested path."""
+    findings = check_plan(
+        _NestedOptModule(break_alias=False), FSDP(), 4,
+        {"x": np.zeros((8, 1024), np.float32)})
+    assert [f.rule for f in findings] == ["RLT105"]
+    assert findings[0].symbol == "slots/1/dense/kernel"
+
+
+def test_donation_mismatch_reports_full_nested_path():
+    findings = check_plan(
+        _NestedOptModule(break_alias=True), FSDP(), 4,
+        {"x": np.zeros((8, 1024), np.float32)})
+    rlt106 = [f for f in findings if f.rule == "RLT106"]
+    assert len(rlt106) == 1
+    f = rlt106[0]
+    # full nested dict/list path, not a top-level key
+    assert f.symbol == "opt_state/slots/1/dense/kernel"
+    # and the near-miss diagnosis names the drifted output
+    assert "Nearest same-shape output" in f.message
+    assert "bfloat16" in f.message

@@ -273,3 +273,64 @@ def test_flagship_leg_inline_fallback_reuses_rematce():
     with pytest.raises(RuntimeError, match="HTTP 500"):
         bench._flagship_leg(failing_measure, {}, lambda t, c: 0.5,
                             "B=8 test-shape")
+
+
+def test_trace_summary_is_parseable():
+    """The tracecheck summary is computed WITHOUT any backend touch and
+    carries ICI bytes + an HBM estimate against an assumed chip."""
+    s = bench._trace_summary()
+    assert "tracecheck" in s, s.get("tracecheck_error")
+    t = s["tracecheck"]
+    assert t["ici_bytes_per_step"] == 0  # one chip: nothing on the wire
+    assert t["est_peak_hbm_bytes"] > 0
+    assert t["hbm_budget_bytes"] > 0
+    assert t["assumed_device_kind"] == "TPU v5e"
+    json.dumps(s)  # must embed into the JSON line as-is
+
+
+def test_kill_line_schema(monkeypatch):
+    """The line a driver kill flushes: same schema as the skip lines —
+    metric/value/vs_baseline present, a 'skipped' field naming the
+    signal, and the tracecheck summary riding along."""
+    monkeypatch.setitem(bench._ANALYSIS, "tracecheck", {"findings": 0})
+    obj = json.loads(bench._kill_line("SIGTERM"))
+    assert obj["metric"] == "llama_0.5b_train_tokens_per_sec_per_chip"
+    assert obj["value"] == 0.0 and obj["vs_baseline"] == 0.0
+    assert obj["skipped"] == "killed: SIGTERM"
+    assert "SIGTERM" in obj["error"]
+    assert obj["tracecheck"] == {"findings": 0}
+
+
+def test_sigterm_flushes_structured_json():
+    """End-to-end BENCH_r05 regression: a driver SIGTERM mid-run
+    produces ONE parseable JSON line (exit 3), never `parsed: null`."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    code = (
+        "import bench, time, sys\n"
+        "bench._install_kill_handlers()\n"
+        "print('READY', flush=True)\n"
+        "time.sleep(60)\n"
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": repo + os.pathsep + os.environ.get(
+               "PYTHONPATH", "")}
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE, text=True, env=env,
+                            cwd=repo)
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == 3
+    obj = json.loads(out.strip().splitlines()[-1])
+    assert obj["skipped"] == "killed: SIGTERM"
+    assert obj["value"] == 0.0
