@@ -480,45 +480,85 @@ def main() -> None:
     if watchdog_s > 0:
         threading.Thread(target=_watchdog, daemon=True).start()
 
-    try:
-        payload = _run()
-    except BackendUnavailable as exc:
-        # environmental skip, not a failure on merit: ONE parseable JSON
-        # line with a "skipped" field (the ISSUE-1 contract) so the
-        # recorder distinguishes "no chip today" from "the model broke";
-        # exit 3 keeps the documented structured-failure status
-        print(json.dumps({
-            "metric": "llama_0.5b_train_tokens_per_sec_per_chip",
-            "value": 0.0,
-            "unit": "tokens/sec",
-            "vs_baseline": 0.0,
-            "skipped": "backend unavailable",
-            "error": str(exc),
-            **_ANALYSIS,
-        }), flush=True)
-        finished.set()
-        raise SystemExit(3) from None
-    except Exception as exc:  # noqa: BLE001 — every failure mode must
-        # surface as the same structured JSON line the watchdog emits
-        # (VERDICT r4 weak #1: a backend-init exception bypassed the
-        # hang watchdog and cost the round its perf evidence). Exit 3 =
-        # structured failure, same code as the watchdog path.
-        print(json.dumps({
-            "metric": "llama_0.5b_train_tokens_per_sec_per_chip",
-            "value": 0.0,
-            "unit": "tokens/sec",
-            "vs_baseline": 0.0,
-            "error": f"{type(exc).__name__}: {exc}",
-            **_ANALYSIS,
-        }), flush=True)
-        finished.set()
-        raise SystemExit(3) from None
-    payload = {**payload, **_ANALYSIS}
+    # Supervised legs (resilience/policy.py, ISSUE 3 satellite): a
+    # MID-RUN backend loss — the tunnel dropping between legs, a
+    # transient UNAVAILABLE after the headline already measured — gets a
+    # bounded restart instead of voiding the round, and the final line
+    # carries the PARTIAL results + restart count either way. FATAL
+    # classifications (OOM, compile error) never retry: deterministic
+    # failures would just replay.
+    from ray_lightning_tpu.resilience.policy import (
+        FailureKind,
+        classify_failure,
+    )
+
+    partial: dict = {}
+    restarts = 0
+    max_restarts = max(0, int(_env_float("RLT_BENCH_RESTARTS", 1)))
+    while True:
+        try:
+            payload = _run(partial)
+            break
+        except BackendUnavailable as exc:
+            # _backend_with_retry already spent its bounded init budget
+            # (RLT_BENCH_MAX_WAIT) — re-retrying here would double the
+            # wait and risk rc=124. With nothing measured this is the
+            # environmental skip; with partial legs in hand it is a
+            # partial result, not a skip.
+            line = {
+                "metric": "llama_0.5b_train_tokens_per_sec_per_chip",
+                "value": 0.0,
+                "unit": "tokens/sec",
+                "vs_baseline": 0.0,
+                **partial,
+                "restarts": restarts,
+                "error": str(exc),
+                **_ANALYSIS,
+            }
+            if partial.get("value"):
+                line["partial"] = True
+            else:
+                line["skipped"] = "backend unavailable"
+            print(json.dumps(line), flush=True)
+            finished.set()
+            raise SystemExit(3) from None
+        except Exception as exc:  # noqa: BLE001 — every failure mode
+            # must surface as the same structured JSON line the watchdog
+            # emits (VERDICT r4 weak #1). Exit 3 = structured failure.
+            fc = classify_failure(exc)
+            if fc.kind == FailureKind.RETRYABLE and restarts < max_restarts:
+                restarts += 1
+                print(f"# mid-run failure [{fc.cause}]: {fc.detail}; "
+                      f"supervised restart {restarts}/{max_restarts}",
+                      file=sys.stderr, flush=True)
+                time.sleep(_env_float("RLT_BENCH_RESTART_BACKOFF_S", 5.0))
+                continue
+            line = {
+                "metric": "llama_0.5b_train_tokens_per_sec_per_chip",
+                "value": 0.0,
+                "unit": "tokens/sec",
+                "vs_baseline": 0.0,
+                **partial,
+                "restarts": restarts,
+                "error": f"{type(exc).__name__}: {exc}",
+                "failure_class": f"{fc.kind}/{fc.cause}",
+                **_ANALYSIS,
+            }
+            if partial.get("value"):
+                line["partial"] = True
+            print(json.dumps(line), flush=True)
+            finished.set()
+            raise SystemExit(3) from None
+    payload = {**payload, "restarts": restarts, **_ANALYSIS}
     print(json.dumps(payload), flush=True)
     finished.set()
 
 
-def _run() -> dict:
+def _run(sink: dict | None = None) -> dict:
+    """One full measurement pass. ``sink`` (the supervisor's partial-
+    result carrier) is updated IN PLACE as legs land, so a mid-run
+    failure leaves everything already measured available to the final
+    JSON line instead of losing the round."""
     device = _backend_with_retry()
     kind = device.device_kind
     peak_tflops = _PEAK_TFLOPS.get(kind, _DEFAULT_PEAK)
@@ -558,7 +598,8 @@ def _run() -> dict:
     fpt = _flops_per_token(cfg, 2048)
     mfu = tps * fpt / (peak_tflops * 1e12)
 
-    results = {
+    results = sink if sink is not None else {}
+    results.update({
         "metric": "llama_0.5b_train_tokens_per_sec_per_chip",
         "value": round(tps, 1),
         "unit": "tokens/sec",
@@ -573,7 +614,7 @@ def _run() -> dict:
         "flops_per_token": round(fpt / 1e9, 3),  # GFLOP
         "probe_matmul_tflops": round(probe, 1),
         **kernels,
-    }
+    })
     mfus = [mfu]
 
     def leg(name, fn):

@@ -28,3 +28,9 @@ JAX_PLATFORMS=cpu python -m ray_lightning_tpu lint \
 # within budget) — docs/STATIC_ANALYSIS.md "tracecheck". CPU-only.
 JAX_PLATFORMS=cpu python -m ray_lightning_tpu trace llama3-8b \
     --topo v5p-64 --json --fail-on error > /dev/null
+
+# resilience gate: a supervised CPU-SPMD run with one injected worker
+# kill must auto-resume from the step-cadence checkpoint and converge
+# (rc=0) — proves kill -> classify -> relaunch -> resume end to end on a
+# box with no accelerator. docs/RESILIENCE.md "fault-injection cookbook".
+JAX_PLATFORMS=cpu python -m ray_lightning_tpu supervise --smoke > /dev/null

@@ -38,6 +38,13 @@ from ray_lightning_tpu.runtime import (
 )
 from ray_lightning_tpu.utils import seed_everything, simulate_cpu_devices
 from ray_lightning_tpu import sweep
+from ray_lightning_tpu.resilience import (
+    ResilienceConfig,
+    RetryPolicy,
+    SupervisedResult,
+    fit_supervised,
+    supervise,
+)
 
 __version__ = "0.1.0"
 
@@ -68,5 +75,10 @@ __all__ = [
     "seed_everything",
     "simulate_cpu_devices",
     "sweep",
+    "ResilienceConfig",
+    "RetryPolicy",
+    "SupervisedResult",
+    "fit_supervised",
+    "supervise",
     "__version__",
 ]

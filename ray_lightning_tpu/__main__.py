@@ -27,6 +27,15 @@ parsed, never executed:
     python -m ray_lightning_tpu lint ray_lightning_tpu/models
     python -m ray_lightning_tpu lint my_project.module --json
 
+``supervise`` runs a distributed fit under the resilience supervisor
+(resilience/supervisor.py, docs/RESILIENCE.md): transient failures
+restart the worker group and resume from the latest valid checkpoint.
+``--smoke`` is the CPU fault-injection convergence gate format.sh runs:
+
+    python -m ray_lightning_tpu supervise --smoke
+    python -m ray_lightning_tpu supervise my_project.jobs:make_job \\
+        --processes 4 --max-restarts 3
+
 Exit status: 0 when the plan fits, 1 when it does not, 2 when the
 configuration is invalid (e.g. a global batch not divisible by the
 data-parallel degree — refused rather than planned wrong; the error goes
@@ -328,9 +337,13 @@ def main(argv=None) -> int:
     from ray_lightning_tpu.analysis.cli import (
         add_lint_parser, add_trace_parser, run_lint, run_trace,
     )
+    from ray_lightning_tpu.resilience.cli import (
+        add_supervise_parser, run_supervise,
+    )
 
     add_lint_parser(sub)
     add_trace_parser(sub)
+    add_supervise_parser(sub)
     args = p.parse_args(argv)
     if args.cmd == "plan":
         return run_plan(args)
@@ -338,6 +351,8 @@ def main(argv=None) -> int:
         return run_lint(args)
     if args.cmd == "trace":
         return run_trace(args)
+    if args.cmd == "supervise":
+        return run_supervise(args)
     info = collect(probe=args.probe)
     if args.as_json:
         print(json.dumps(info))

@@ -101,6 +101,14 @@ RULES: Dict[str, Rule] = {r.id: r for r in (
          "that is not a single cycle) or collective sequences diverge "
          "across cond branches — SPMD ranks deadlock or exchange "
          "garbage"),
+    # RLT4xx — resilience anti-patterns (docs/RESILIENCE.md): code shapes
+    # that defeat the supervision layer's failure classification.
+    Rule("RLT401", "unsupervised-worker-failure", "warning",
+         "a bare/broad except silently swallows worker-group failures "
+         "(WorkerError never reaches the supervisor, so a dead rank "
+         "looks like success), or a started WorkerGroup has no "
+         "shutdown() in a finally / context manager (a failure leaks "
+         "worker processes and their hosts' chips)"),
 )}
 
 

@@ -447,6 +447,202 @@ def _lint_traced_body(lint: _FileLint, fn: _Func) -> None:
                          "sort it", node.iter, sym)
 
 
+#: APIs whose failures surface as WorkerError (or carry one): a trivial
+#: broad except around these is the anti-pattern RLT401 names. The
+#: distinctive names match anywhere; the GENERIC ones (`launch`,
+#: `supervise` — plenty of unrelated code has an `app.launch()`) match
+#: only when the file imports them from this package.
+_WORKER_API_NAMES: Set[str] = {
+    "WorkerGroup", "WorkerError", "fit_distributed", "run_distributed",
+    "validate_distributed", "test_distributed", "predict_distributed",
+    "launch_cpu_spmd", "fit_supervised",
+}
+
+_WORKER_API_GENERIC: Set[str] = {"launch", "supervise"}
+
+#: group-handle methods: `<something>group.run(...)` etc.
+_WORKER_GROUP_METHODS: Set[str] = {"run", "run_single", "wait", "start"}
+
+
+def _is_trivial_handler_body(body: List[ast.stmt]) -> bool:
+    """Only pass/continue/`...` — the failure vanishes without a trace."""
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)
+                and stmt.value.value is Ellipsis):
+            continue
+        return False
+    return bool(body)
+
+
+def _handler_swallows(handler: ast.ExceptHandler) -> Optional[str]:
+    """The caught-type description when this handler is broad enough to
+    eat a WorkerError (bare, Exception/BaseException, or WorkerError
+    itself — directly or inside a tuple), else None."""
+    t = handler.type
+    if t is None:
+        return "bare except:"
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    for el in types:
+        name = _dotted(el)
+        base = name.split(".")[-1] if name else None
+        if base in ("Exception", "BaseException", "WorkerError"):
+            return f"except {base}"
+    return None
+
+
+def _mentions_worker_api(nodes: List[ast.stmt],
+                         known: Set[str]) -> Optional[str]:
+    """A worker-API name used inside these statements, else None."""
+    for stmt in nodes:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name) and node.id in known:
+                return node.id
+            if isinstance(node, ast.Attribute):
+                if node.attr in known:
+                    return node.attr
+                if (node.attr in _WORKER_GROUP_METHODS
+                        and isinstance(node.value, ast.Name)
+                        and "group" in node.value.id.lower()):
+                    return f"{node.value.id}.{node.attr}"
+    return None
+
+
+class _ResilienceLint(ast.NodeVisitor):
+    """RLT401: the two code shapes that defeat supervision.
+
+    (a) a bare/broad ``except`` with a trivial body (pass/continue/...)
+        wrapped around worker-group APIs — the WorkerError carrying the
+        dead rank's classification and log tail evaporates;
+    (b) a ``WorkerGroup`` that is started but has no ``shutdown()``
+        reachable from a ``finally`` and is not managed by ``with`` —
+        the failure path leaks live worker processes (and on a pod,
+        their hosts' chips). Groups handed away (returned, stored on
+        self) are the caller's responsibility and are not flagged.
+    """
+
+    def __init__(self, lint: "_FileLint"):
+        self.lint = lint
+        self._known = set(_WORKER_API_NAMES)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        # the generic names ('launch', 'supervise') become worker APIs
+        # only with import evidence — an unrelated app.launch() must
+        # never trip the rule
+        if node.module and node.module.startswith("ray_lightning_tpu"):
+            for alias in node.names:
+                if alias.name in _WORKER_API_GENERIC:
+                    self._known.add(alias.asname or alias.name)
+        self.generic_visit(node)
+
+    def visit_Try(self, node: ast.Try):
+        for handler in node.handlers:
+            caught = _handler_swallows(handler)
+            if caught is None or not _is_trivial_handler_body(handler.body):
+                continue
+            api = _mentions_worker_api(node.body, self._known)
+            if api is not None:
+                self.lint.add(
+                    "RLT401",
+                    f"{caught} with a pass-only body swallows failures "
+                    f"from {api}() — a dead worker's WorkerError (rank, "
+                    "cause, log tail) vanishes and the run reads as "
+                    "success; let it propagate to the supervisor, or "
+                    "handle and re-raise",
+                    handler)
+        self.generic_visit(node)
+
+    @staticmethod
+    def _walk_scope(body: List[ast.stmt]):
+        """Every node under these statements EXCLUDING nested function
+        bodies (each def is its own ownership scope)."""
+        stack: List[ast.AST] = list(body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _scan_scope(self, body: List[ast.stmt]) -> None:
+        """One function scope (nested defs are their own scopes)."""
+        assigns: List[Tuple[str, ast.Call]] = []
+        started: Set[str] = set()
+        shutdown_in_finally: Set[str] = set()
+        with_managed: Set[str] = set()
+        escaped: Set[str] = set()  # returned/yielded: ownership left
+        for node in self._walk_scope(body):
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call):
+                call = node.value
+                chained_start = False
+                if (isinstance(call.func, ast.Attribute)
+                        and call.func.attr == "start"
+                        and isinstance(call.func.value, ast.Call)):
+                    # g = WorkerGroup(...).start()
+                    call = call.func.value
+                    chained_start = True
+                callee = _dotted(call.func) or ""
+                if callee.split(".")[-1] == "WorkerGroup":
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            assigns.append((tgt.id, call))
+                            if chained_start:
+                                started.add(tgt.id)
+                        # self.group = WorkerGroup(...): lifecycle is
+                        # managed elsewhere on the object — not flagged
+            elif isinstance(node, ast.With):
+                for item in node.items:
+                    ctx = item.context_expr
+                    if isinstance(ctx, ast.Name):
+                        with_managed.add(ctx.id)
+            elif isinstance(node, (ast.Return, ast.Yield)) and isinstance(
+                    getattr(node, "value", None), ast.Name):
+                escaped.add(node.value.id)
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.attr == "start"):
+                started.add(node.func.value.id)
+            elif isinstance(node, ast.Try) and node.finalbody:
+                for fin_node in self._walk_scope(node.finalbody):
+                    if (isinstance(fin_node, ast.Call)
+                            and isinstance(fin_node.func, ast.Attribute)
+                            and fin_node.func.attr == "shutdown"
+                            and isinstance(fin_node.func.value, ast.Name)):
+                        shutdown_in_finally.add(fin_node.func.value.id)
+        for name, call in assigns:
+            if name in with_managed or name in escaped:
+                continue
+            if name not in started:
+                continue  # never started: nothing leaked yet
+            if name in shutdown_in_finally:
+                continue
+            self.lint.add(
+                "RLT401",
+                f"WorkerGroup {name!r} is start()ed with no "
+                f"{name}.shutdown() in a finally and no `with` block — "
+                "a failure between start and teardown leaks the worker "
+                "processes (on a pod: their hosts' chips). Use `with "
+                "WorkerGroup(...) as g:` or try/finally",
+                call)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self._scan_scope(node.body)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef):
+        self._scan_scope(node.body)
+        self.generic_visit(node)
+
+    def visit_Module(self, node: ast.Module):
+        self._scan_scope(node.body)
+        self.generic_visit(node)
+
+
 def lint_source(source: str, filename: str = "<string>",
                 extra_axes: Sequence[str] = ()) -> List[Finding]:
     """Lint one file's source text. Never imports the target."""
@@ -461,6 +657,13 @@ def lint_source(source: str, filename: str = "<string>",
 
     coll = _Collector(lint)
     coll.visit(tree)
+    res = _ResilienceLint(lint)
+    # imports first, regardless of where they sit in the file (a Try
+    # above a late import must still see the imported generic names)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            res.visit_ImportFrom(node)
+    res.visit(tree)
 
     # traced-set fixpoint: containment + same-file call edges
     changed = True

@@ -1,9 +1,12 @@
 from ray_lightning_tpu.checkpoint.io import (
     save_checkpoint,
     load_checkpoint,
+    latest_checkpoint,
     restore_checkpoint,
+    verify_checkpoint,
     wait_for_checkpoints,
 )
 
-__all__ = ["save_checkpoint", "load_checkpoint", "restore_checkpoint",
+__all__ = ["save_checkpoint", "load_checkpoint", "latest_checkpoint",
+           "restore_checkpoint", "verify_checkpoint",
            "wait_for_checkpoints"]

@@ -13,10 +13,23 @@ scaling hazard it explicitly must NOT copy for 8B-param models. Here:
 
 Layout of a checkpoint directory:
     <path>/state/     orbax pytree ({"params", "opt_state", "step"} or subset)
-    <path>/meta.json  {epoch, global_step, module_class, hparams_pickle_hex}
+    <path>/meta.json  {epoch, global_step, module_class, hparams_pickle_hex,
+                       ckpt_digest, ckpt_files, ckpt_digest_mode}
+
+Atomicity & verifiability (the resilience subsystem's resume source of
+truth, docs/RESILIENCE.md): orbax itself writes the state tree into a
+temp dir and renames on finalize, so the state dir is never observable
+half-written; meta.json — the "checkpoint is complete" marker — is
+written AFTER the state finalizes, to a temp file + os.replace (atomic
+on POSIX), and records a content digest of the finalized state files.
+``latest_checkpoint(dir)`` walks candidates newest-first and returns the
+first that VERIFIES — torn dirs (no meta), partial dirs (file-set
+mismatch) and corrupt dirs (digest mismatch) are skipped, so a
+supervisor resume can never load the checkpoint the crash tore.
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import pickle
@@ -24,6 +37,10 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import orbax.checkpoint as ocp
+
+from ray_lightning_tpu.utils import get_logger
+
+log = get_logger(__name__)
 
 _STATE_DIR = "state"
 _META_FILE = "meta.json"
@@ -83,10 +100,131 @@ def save_checkpoint(
     return path
 
 
+#: digest policy (env RLT_CKPT_DIGEST): "full" hashes file contents —
+#: the default, and what corrupt-checkpoint detection needs; "size"
+#: hashes only (relpath, size) — cheap at 8B scale, still catches torn
+#: and truncated files; "off" records no digest.
+_DIGEST_MODE_ENV = "RLT_CKPT_DIGEST"
+
+
+def _digest_mode() -> str:
+    mode = os.environ.get(_DIGEST_MODE_ENV, "full")
+    return mode if mode in ("full", "size", "off") else "full"
+
+
+def compute_state_digest(path: str, mode: str = "full") -> Tuple[str, int]:
+    """(sha256 hexdigest, file count) over the finalized state dir —
+    deterministic: files visited in sorted relpath order."""
+    state_dir = os.path.join(os.path.abspath(path), _STATE_DIR)
+    h = hashlib.sha256()
+    count = 0
+    entries = []
+    for root, dirs, files in os.walk(state_dir):
+        dirs.sort()
+        for f in sorted(files):
+            full = os.path.join(root, f)
+            entries.append((os.path.relpath(full, state_dir), full))
+    for rel, full in sorted(entries):
+        size = os.path.getsize(full)
+        h.update(f"{rel}\x00{size}\x00".encode())
+        count += 1
+        if mode == "full":
+            with open(full, "rb") as fh:
+                for chunk in iter(lambda: fh.read(1 << 20), b""):
+                    h.update(chunk)
+    return h.hexdigest(), count
+
+
 def _write_meta(path: str, meta: Dict[str, Any]) -> None:
-    if jax.process_index() == 0:
-        with open(os.path.join(path, _META_FILE), "w") as f:
-            json.dump(meta, f)
+    if jax.process_index() != 0:
+        return
+    meta = dict(meta)
+    mode = _digest_mode()
+    meta["ckpt_digest_mode"] = mode
+    if mode != "off":
+        try:
+            digest, count = compute_state_digest(path, mode)
+            meta["ckpt_digest"] = digest
+            meta["ckpt_files"] = count
+        except OSError:
+            # a digest failure must not lose the checkpoint itself; the
+            # meta lands digest-less and verification degrades to
+            # presence checks
+            log.exception("could not digest checkpoint %s", path)
+    meta_path = os.path.join(path, _META_FILE)
+    tmp = meta_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    # atomic publish: a crash mid-write leaves only the tmp file and the
+    # checkpoint reads as incomplete (no meta.json), never as torn JSON
+    os.replace(tmp, meta_path)
+
+
+def verify_checkpoint(path: str) -> Tuple[bool, str]:
+    """Is this directory a complete, uncorrupted checkpoint?
+    Returns (ok, reason) — reason names the first failed check."""
+    path = os.path.abspath(path)
+    state_dir = os.path.join(path, _STATE_DIR)
+    if not os.path.isdir(state_dir):
+        return False, "no state dir (write never started or was removed)"
+    meta_path = os.path.join(path, _META_FILE)
+    if not os.path.exists(meta_path):
+        return False, "no meta.json (write never finalized — torn)"
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except (OSError, ValueError) as exc:
+        return False, f"unreadable meta.json ({exc})"
+    recorded = meta.get("ckpt_digest")
+    mode = meta.get("ckpt_digest_mode", "off")
+    if recorded and mode in ("full", "size"):
+        try:
+            digest, count = compute_state_digest(path, mode)
+        except OSError as exc:
+            return False, f"state unreadable ({exc})"
+        if count != meta.get("ckpt_files", count):
+            return False, (f"partial state: {count} files on disk vs "
+                           f"{meta.get('ckpt_files')} recorded")
+        if digest != recorded:
+            return False, "digest mismatch (corrupt or tampered state)"
+    return True, "ok"
+
+
+def latest_checkpoint(directory: str) -> Optional[str]:
+    """Newest VALID checkpoint under ``directory`` (the dir itself is
+    also considered, so both a checkpoint path and a dir of checkpoints
+    work). Candidates ordered by recorded global_step (mtime breaks
+    ties), newest first; torn/partial/corrupt candidates are skipped
+    with a logged reason. None when nothing valid exists."""
+    directory = os.path.abspath(directory)
+    if not os.path.isdir(directory):
+        return None
+    candidates = []
+    names = [directory] + [
+        os.path.join(directory, d) for d in os.listdir(directory)
+        if os.path.isdir(os.path.join(directory, d))
+    ]
+    for cand in names:
+        if not os.path.isdir(os.path.join(cand, _STATE_DIR)):
+            continue
+        step = -1
+        meta_path = os.path.join(cand, _META_FILE)
+        try:
+            with open(meta_path) as f:
+                step = int(json.load(f).get("global_step", -1))
+        except (OSError, ValueError, TypeError):
+            pass  # still a candidate; verify_checkpoint rejects it below
+        try:
+            mtime = os.path.getmtime(cand)
+        except OSError:
+            continue
+        candidates.append((step, mtime, cand))
+    for _, _, cand in sorted(candidates, reverse=True):
+        ok, reason = verify_checkpoint(cand)
+        if ok:
+            return cand
+        log.warning("skipping invalid checkpoint %s: %s", cand, reason)
+    return None
 
 
 def _flush_pending_meta() -> None:
@@ -146,7 +284,18 @@ def restore_checkpoint(path: str, target: Any) -> Any:
                                        sharding=getattr(x, "sharding", None)),
         target,
     )
-    return _checkpointer().restore(os.path.join(path, _STATE_DIR), abstract)
+    restored = _checkpointer().restore(os.path.join(path, _STATE_DIR),
+                                       abstract)
+    # Copy out of orbax/TensorStore-owned buffers before handing the tree
+    # to callers: the Trainer DONATES its whole TrainState into the
+    # jitted step, and donating a restored array whose buffer the
+    # checkpoint runtime still references lets XLA reuse memory it does
+    # not own — observed on the CPU backend as intermittent SIGSEGV /
+    # SIGABRT mid-run and, worse, silently corrupted params after a
+    # resume (flaky denormal garbage in the resumed weights). A jitted
+    # identity without donation cannot alias its inputs, so it
+    # materializes fresh runtime-owned buffers with the same shardings.
+    return jax.jit(lambda t: t)(restored)
 
 
 def read_meta(path: str) -> Dict[str, Any]:

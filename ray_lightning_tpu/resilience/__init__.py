@@ -1,0 +1,91 @@
+"""Resilience: supervised elastic training over the runtime substrate.
+
+The reference delegated every fault to Ray's actor-restart machinery; our
+native runtime (runtime/group.py, runtime/fit.py) detects a dead worker
+and raises — one SIGTERM'd host on a v5p-64 used to lose the whole run.
+This package is the supervision layer between the driver API and the
+worker group (docs/RESILIENCE.md):
+
+  * policy.py     — failure taxonomy (RETRYABLE / PREEMPTION / FATAL) +
+                    RetryPolicy (capped exponential backoff, restart
+                    budget); import-light by design (no jax).
+  * supervisor.py — supervise()/fit_supervised(): tear down, re-launch,
+                    resume from the latest VALID checkpoint
+                    (checkpoint.latest_checkpoint) via the trainer's
+                    mid-epoch resume bookkeeping.
+  * preempt.py    — SIGTERM/preemption notice -> flag-only handler ->
+                    emergency checkpoint + graceful drain at the next
+                    batch boundary (the async-signal-safe pattern of
+                    bench.py's kill handlers).
+  * health.py     — per-worker heartbeats over the existing queue
+                    channel + a stall watchdog that distinguishes
+                    "compiling" (live channel, no step progress) from
+                    "hung" (silent channel).
+  * faults.py     — deterministic fault injection (kill worker R at
+                    step N, drop the coordinator, corrupt the latest
+                    checkpoint, ...) via RLT_FAULTS, so the whole
+                    subsystem is testable on CPU with launch_cpu_spmd.
+
+Surfaces: ``fit_distributed(..., resilience=ResilienceConfig(...))``,
+``python -m ray_lightning_tpu supervise``, and sweep trial-level retry
+(``sweep.run(..., retry_policy=RetryPolicy(...))``).
+"""
+from ray_lightning_tpu.resilience.policy import (
+    FailureClass,
+    FailureKind,
+    RetryPolicy,
+    StallError,
+    classify_failure,
+)
+from ray_lightning_tpu.resilience.preempt import (
+    PreemptedError,
+    PreemptionGuard,
+    install_preemption_handlers,
+    preemption_requested,
+    reset_preemption,
+)
+from ray_lightning_tpu.resilience.health import (
+    HEARTBEAT_KIND,
+    HealthMonitor,
+    HeartbeatCallback,
+)
+from ray_lightning_tpu.resilience.faults import (
+    Fault,
+    FaultInjector,
+    corrupt_checkpoint,
+    parse_faults,
+)
+from ray_lightning_tpu.resilience.supervisor import (
+    ResilienceConfig,
+    RestartBudgetExceeded,
+    SupervisedFailure,
+    SupervisedResult,
+    fit_supervised,
+    supervise,
+)
+
+__all__ = [
+    "FailureClass",
+    "FailureKind",
+    "RetryPolicy",
+    "StallError",
+    "classify_failure",
+    "PreemptedError",
+    "PreemptionGuard",
+    "install_preemption_handlers",
+    "preemption_requested",
+    "reset_preemption",
+    "HEARTBEAT_KIND",
+    "HealthMonitor",
+    "HeartbeatCallback",
+    "Fault",
+    "FaultInjector",
+    "corrupt_checkpoint",
+    "parse_faults",
+    "ResilienceConfig",
+    "RestartBudgetExceeded",
+    "SupervisedFailure",
+    "SupervisedResult",
+    "fit_supervised",
+    "supervise",
+]

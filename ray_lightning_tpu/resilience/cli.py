@@ -1,0 +1,223 @@
+"""``python -m ray_lightning_tpu supervise`` — run a training job under
+the resilience supervisor, from the command line.
+
+Two modes:
+
+  --smoke         the CI fault-injection gate (wired into format.sh): a
+                  supervised CPU-SPMD MNIST-class run with one injected
+                  worker kill. It must auto-resume from the step-cadence
+                  checkpoint and converge — exit 0 proves the whole
+                  kill -> classify -> relaunch -> resume path on a box
+                  with no accelerator.
+
+  <target>        ``pkg.mod:factory`` where factory() returns a dict with
+                  module_factory / trainer_factory / data_factory — the
+                  same triple fit_distributed takes. Supervision knobs
+                  (--max-restarts, --faults, --checkpoint-dir) apply.
+
+Fault specs (--faults / RLT_FAULTS) are documented in
+resilience/faults.py and docs/RESILIENCE.md.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+# ---- smoke job: module-level factories (cloudpickled by reference;
+# workers import this module, which is on their path by construction) ----
+
+_SMOKE_CLASSES = 4
+_SMOKE_ROWS = 256
+_SMOKE_BATCH = 16
+
+
+def _smoke_module():
+    from ray_lightning_tpu.models.mlp import MLPClassifier
+
+    return MLPClassifier(features=(32,), num_classes=_SMOKE_CLASSES, lr=5e-2)
+
+
+def _smoke_trainer():
+    from ray_lightning_tpu import DataParallel, Trainer
+
+    return Trainer(
+        strategy=DataParallel(),
+        max_epochs=2,
+        enable_progress_bar=False,
+        enable_checkpointing=False,  # the supervisor adds its own cadence
+        seed=0,
+    )
+
+
+def _smoke_data():
+    import jax
+    import numpy as np
+
+    from ray_lightning_tpu import DataLoader
+
+    rng = np.random.default_rng(0)
+    centers = rng.normal(size=(_SMOKE_CLASSES, 8)) * 3
+    y = rng.integers(0, _SMOKE_CLASSES, size=_SMOKE_ROWS)
+    x = (centers[y] + rng.normal(size=(_SMOKE_ROWS, 8)) * 0.1).astype(
+        np.float32)
+    shard = dict(num_shards=jax.process_count(),
+                 shard_index=jax.process_index())
+    train = DataLoader({"x": x, "y": y}, batch_size=_SMOKE_BATCH,
+                       shuffle=True, **shard)
+    val = DataLoader({"x": x, "y": y}, batch_size=_SMOKE_BATCH, **shard)
+    return train, val
+
+
+def add_supervise_parser(sub) -> None:
+    p = sub.add_parser(
+        "supervise",
+        help="run a distributed fit under the resilience supervisor "
+             "(restart + resume on transient failures; "
+             "docs/RESILIENCE.md)")
+    p.add_argument("target", nargs="?", default=None,
+                   help="pkg.mod:factory returning {module_factory, "
+                        "trainer_factory, data_factory}; omit with "
+                        "--smoke")
+    p.add_argument("--smoke", action="store_true",
+                   help="built-in CPU-SPMD convergence gate with one "
+                        "injected worker kill (the format.sh gate)")
+    p.add_argument("--processes", type=int, default=2)
+    p.add_argument("--devices-per-process", type=int, default=1)
+    p.add_argument("--platform", default="cpu",
+                   help="jax platform for the workers (cpu for the "
+                        "smoke gate; unset/tpu on a pod)")
+    p.add_argument("--faults", default=None,
+                   help="fault-injection plan, e.g. 'kill:rank=1,step=3' "
+                        "(default for --smoke: exactly that)")
+    p.add_argument("--max-restarts", type=int, default=2)
+    p.add_argument("--save-every", type=int, default=1,
+                   help="step-cadence checkpoint interval the resume "
+                        "rides on")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="supervisor checkpoint dir (default: a temp dir "
+                        "for --smoke, ./rlt_logs/supervise otherwise)")
+    p.add_argument("--stall-timeout", type=float, default=0.0,
+                   help="silent-heartbeat budget in seconds "
+                        "(0 disables the stall watchdog)")
+    p.add_argument("--timeout", type=float, default=600.0,
+                   help="per-attempt wall-clock budget")
+    # same SUPPRESS trick as the plan parser: don't clobber a --json
+    # given before the subcommand
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   default=argparse.SUPPRESS)
+
+
+def _load_target(spec: str):
+    import importlib
+
+    mod_name, _, attr = spec.partition(":")
+    if not attr:
+        raise SystemExit(f"error: target must be pkg.mod:factory, "
+                         f"got {spec!r}")
+    factory = getattr(importlib.import_module(mod_name), attr)
+    job = factory()
+    missing = {"module_factory", "trainer_factory", "data_factory"} - set(job)
+    if missing:
+        raise SystemExit(
+            f"error: {spec} returned no {sorted(missing)} "
+            "(need module_factory/trainer_factory/data_factory)")
+    return job
+
+
+def run_supervise(args) -> int:
+    import os
+    import tempfile
+
+    from ray_lightning_tpu.resilience.policy import RetryPolicy
+    from ray_lightning_tpu.resilience.supervisor import (
+        ResilienceConfig,
+        SupervisedFailure,
+        fit_supervised,
+    )
+
+    if not args.smoke and not args.target:
+        print("error: pass a pkg.mod:factory target or --smoke",
+              file=sys.stderr)
+        return 2
+    if args.smoke:
+        job = {"module_factory": _smoke_module,
+               "trainer_factory": _smoke_trainer,
+               "data_factory": _smoke_data}
+        faults = args.faults if args.faults is not None else (
+            f"kill:rank={min(1, args.processes - 1)},step=3")
+    else:
+        job = _load_target(args.target)
+        faults = args.faults
+
+    ckpt_dir = args.checkpoint_dir or (
+        tempfile.mkdtemp(prefix="rlt_supervise_smoke_") if args.smoke
+        else os.path.join(os.getcwd(), "rlt_logs", "supervise"))
+    cfg = ResilienceConfig(
+        checkpoint_dir=ckpt_dir,
+        policy=RetryPolicy(max_restarts=args.max_restarts,
+                           backoff_base_s=0.5 if args.smoke else 2.0),
+        save_every_n_steps=args.save_every,
+        stall_timeout_s=args.stall_timeout,
+        heartbeat_interval_s=1.0 if args.smoke else 5.0,
+        faults=faults,
+    )
+    out: dict = {"checkpoint_dir": ckpt_dir, "faults": faults}
+    try:
+        supervised = fit_supervised(
+            job["module_factory"], job["trainer_factory"],
+            job["data_factory"], args.processes,
+            resilience=cfg,
+            platform=args.platform or None,
+            num_cpu_devices_per_process=(
+                args.devices_per_process if args.platform == "cpu"
+                else None),
+            return_weights=False,
+            timeout=args.timeout,
+        )
+    except SupervisedFailure as exc:
+        out.update({"ok": False, "error": str(exc),
+                    "classified": exc.classified.to_dict()})
+        print(json.dumps(out) if getattr(args, "as_json", False)
+              else f"supervise FAILED: {exc}",
+              file=None if getattr(args, "as_json", False) else sys.stderr)
+        return 1
+    metrics = supervised.result.metrics
+    acc = metrics.get("ptl/val_accuracy")
+    out.update({
+        "ok": True,
+        "restarts": supervised.restarts,
+        "preemptions": supervised.preemptions,
+        "attempts": supervised.total_attempts,
+        "failures": supervised.failures,
+        "metrics": {k: v for k, v in metrics.items()
+                    if isinstance(v, (int, float))},
+    })
+    if args.smoke:
+        # the gate's contract: the kill FIRED (otherwise the run proved
+        # nothing) and the resumed run still converged
+        recovered = supervised.total_attempts >= 2
+        converged = acc is not None and float(acc) > 0.8
+        out["ok"] = recovered and converged
+        if not recovered:
+            out["error"] = ("injected fault never fired — the smoke run "
+                            "exercised nothing")
+        elif not converged:
+            out["error"] = f"resumed run did not converge (acc={acc})"
+    if getattr(args, "as_json", False):
+        print(json.dumps(out))
+    else:
+        status = "ok" if out["ok"] else "FAILED"
+        print(f"supervise {status}: attempts={out['attempts']} "
+              f"restarts={out['restarts']} "
+              f"preemptions={out['preemptions']} "
+              + (f"val_accuracy={float(acc):.3f}" if acc is not None
+                 else ""))
+        for f in supervised.failures:
+            print(f"  attempt {f['attempt']}: [{f['kind']}/{f['cause']}"
+                  + (f" rank {f['rank']}" if f.get("rank") is not None
+                     else "") + f"] {f['detail']}")
+        if not out["ok"]:
+            print(f"error: {out.get('error')}", file=sys.stderr)
+    return 0 if out["ok"] else 1

@@ -1,0 +1,219 @@
+"""Deterministic fault injection — the test harness the subsystem is
+built against (TorchTitan-style: every recovery path must be provable on
+CPU, no pod required).
+
+A fault plan is a spec string (env ``RLT_FAULTS`` or
+``ResilienceConfig.faults``), semicolon-separated::
+
+    kill:rank=1,step=3            SIGKILL the worker (a vanished host)
+    preempt:rank=0,step=2         SIGTERM self (a preemption notice;
+                                  rank 0 = "drop the coordinator" when
+                                  combined with kill)
+    raise:rank=0,step=2           raise RuntimeError (a FATAL user bug)
+    exit:rank=1,step=3,rc=7       os._exit(rc) (a crashed runtime)
+    hang:rank=1,step=3,secs=600   stop stepping AND stop heartbeating
+                                  (exercises the stall watchdog)
+    corrupt_latest:rank=0,step=3,dir=/ckpts
+                                  flip bytes in the newest checkpoint's
+                                  state (latest_checkpoint must skip it)
+
+``rank=*`` matches every rank. Each fault fires ONCE per plan across
+restarts: a marker file is written under ``RLT_FAULT_STATE_DIR`` BEFORE
+the fault fires (crash-safe ordering — a kill cannot lose the marker),
+so the restarted run sails past the step that killed its predecessor.
+Without a state dir, once-ness is per-process only.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import time
+from typing import Dict, List, Optional
+
+from ray_lightning_tpu.core.callbacks import Callback
+from ray_lightning_tpu.utils import get_logger
+
+log = get_logger(__name__)
+
+FAULTS_ENV = "RLT_FAULTS"
+FAULT_STATE_ENV = "RLT_FAULT_STATE_DIR"
+
+_KINDS = ("kill", "preempt", "raise", "exit", "hang", "corrupt_latest")
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    kind: str
+    rank: Optional[int]          # None = every rank ("*")
+    step: int                    # fires when global_step >= step
+    args: Dict[str, str] = dataclasses.field(default_factory=dict)
+    index: int = 0               # position in the plan (the marker key)
+
+    def marker(self, rank: int) -> str:
+        # per-RANK once-ness: a rank=* fault (e.g. the all-hosts SIGTERM
+        # of a pod preemption) must fire on EVERY matching rank — a
+        # shared marker would let the first rank to reach the step
+        # suppress the others, leaving one rank draining through a
+        # collective emergency save the rest never joined (observed as a
+        # gloo EnforceNotMet -> SIGABRT)
+        return f"fault-{self.index}-{self.kind}-step{self.step}-r{rank}"
+
+    def matches(self, rank: int, step: int) -> bool:
+        return (self.rank is None or self.rank == rank) and step >= self.step
+
+
+def parse_faults(spec: Optional[str]) -> List[Fault]:
+    """Parse a plan spec; raises ValueError with the offending clause so
+    a typo'd injection fails the run loudly instead of silently testing
+    nothing."""
+    faults: List[Fault] = []
+    for i, clause in enumerate(c.strip() for c in (spec or "").split(";")):
+        if not clause:
+            continue
+        kind, _, rest = clause.partition(":")
+        kind = kind.strip()
+        if kind not in _KINDS:
+            raise ValueError(
+                f"unknown fault kind {kind!r} in {clause!r} "
+                f"(known: {', '.join(_KINDS)})")
+        args: Dict[str, str] = {}
+        for pair in filter(None, (p.strip() for p in rest.split(","))):
+            k, sep, v = pair.partition("=")
+            if not sep:
+                raise ValueError(f"malformed fault arg {pair!r} in {clause!r}")
+            args[k.strip()] = v.strip()
+        rank_s = args.pop("rank", "*")
+        rank = None if rank_s == "*" else int(rank_s)
+        step = int(args.pop("step", "1"))
+        faults.append(Fault(kind, rank, step, args, index=i))
+    return faults
+
+
+def corrupt_checkpoint(path: str) -> bool:
+    """Flip bytes mid-way through the largest file under ``path`` —
+    a torn/garbled write the checksum in meta.json must catch. Returns
+    True when something was corrupted."""
+    biggest, size = None, -1
+    for root, _, files in os.walk(path):
+        for f in files:
+            if f == "meta.json":
+                continue  # corrupt STATE, keep the completeness marker —
+                # the checkpoint must look finished-but-damaged
+            p = os.path.join(root, f)
+            try:
+                s = os.path.getsize(p)
+            except OSError:
+                continue
+            if s > size:
+                biggest, size = p, s
+    if biggest is None or size <= 0:
+        return False
+    with open(biggest, "r+b") as fh:
+        fh.seek(size // 2)
+        chunk = fh.read(64) or b"\x00"
+        fh.seek(size // 2)
+        fh.write(bytes(b ^ 0xFF for b in chunk))
+    log.warning("fault injection: corrupted %s (%d bytes at offset %d)",
+                biggest, len(chunk), size // 2)
+    return True
+
+
+class FaultInjector(Callback):
+    """Fires plan faults at batch boundaries on the matching rank."""
+
+    def __init__(self, faults: List[Fault],
+                 state_dir: Optional[str] = None):
+        self.faults = faults
+        self.state_dir = state_dir
+        self._fired_local: set = set()
+
+    # -- once-ness ---------------------------------------------------------
+    def _already_fired(self, fault: Fault, rank: int) -> bool:
+        marker = fault.marker(rank)
+        if marker in self._fired_local:
+            return True
+        if self.state_dir:
+            return os.path.exists(os.path.join(self.state_dir, marker))
+        return False
+
+    def _mark_fired(self, fault: Fault, rank: int) -> None:
+        # marker BEFORE the fault fires: a kill must not re-fire on resume
+        marker = fault.marker(rank)
+        self._fired_local.add(marker)
+        if self.state_dir:
+            os.makedirs(self.state_dir, exist_ok=True)
+            with open(os.path.join(self.state_dir, marker), "w") as f:
+                f.write(str(time.time()))
+
+    # -- firing ------------------------------------------------------------
+    def _rank(self) -> int:
+        from ray_lightning_tpu.runtime import session
+
+        if session.is_session_enabled():
+            return session.get_actor_rank()
+        return 0
+
+    def _fire(self, fault: Fault, trainer) -> None:
+        log.warning("fault injection: firing %s (rank=%s step>=%d) at "
+                    "global_step=%d", fault.kind, fault.rank, fault.step,
+                    trainer.global_step)
+        if fault.kind == "kill":
+            os.kill(os.getpid(), signal.SIGKILL)
+        elif fault.kind == "exit":
+            os._exit(int(fault.args.get("rc", "1")))
+        elif fault.kind == "preempt":
+            # deliver a real SIGTERM: the flag-only handler + the
+            # PreemptionGuard drain are both on the tested path
+            os.kill(os.getpid(), signal.SIGTERM)
+        elif fault.kind == "raise":
+            raise RuntimeError(
+                f"injected fatal failure at step {trainer.global_step} "
+                f"(fault plan #{fault.index})")
+        elif fault.kind == "hang":
+            time.sleep(float(fault.args.get("secs", "600")))
+        elif fault.kind == "corrupt_latest":
+            target = fault.args.get("dir")
+            if not target:
+                raise ValueError("corrupt_latest fault needs dir=<ckpt dir>")
+            newest = _newest_checkpoint_dir(target)
+            if newest is not None:
+                corrupt_checkpoint(newest)
+
+    def on_train_batch_end(self, trainer, module, metrics, batch_idx) -> None:
+        rank = self._rank()
+        for fault in self.faults:
+            if not fault.matches(rank, trainer.global_step):
+                continue
+            if self._already_fired(fault, rank):
+                continue
+            self._mark_fired(fault, rank)
+            self._fire(fault, trainer)
+
+
+def _newest_checkpoint_dir(root: str) -> Optional[str]:
+    """Newest checkpoint SUBDIR by mtime — deliberately NOT
+    latest_checkpoint(): the injector wants the newest dir regardless of
+    validity; the validity filter is the code under test."""
+    try:
+        subdirs = [os.path.join(root, d) for d in os.listdir(root)
+                   if os.path.isdir(os.path.join(root, d))]
+    except OSError:
+        return None
+    return max(subdirs, key=os.path.getmtime, default=None)
+
+
+def faults_from_env() -> List[Fault]:
+    return parse_faults(os.environ.get(FAULTS_ENV))
+
+
+def maybe_install_faults(trainer) -> Optional[FaultInjector]:
+    """Attach a FaultInjector built from the environment (no-op without
+    RLT_FAULTS). Called by the supervisor's worker-side trainer wrapper;
+    usable directly by any test harness."""
+    faults = faults_from_env()
+    if not faults:
+        return None
+    injector = FaultInjector(faults, os.environ.get(FAULT_STATE_ENV))
+    trainer.callbacks.append(injector)
+    return injector

@@ -1,0 +1,212 @@
+"""Failure taxonomy + retry policy — the supervisor's decision core.
+
+Classification answers ONE question: is restarting the worker group and
+resuming from the latest valid checkpoint going to help? Three answers:
+
+  RETRYABLE  — infrastructure flaked (backend unavailable, a worker
+               process vanished with a nonzero rc, a stall/timeout, a
+               dropped coordinator). The SAME job on the SAME data is
+               expected to succeed; restart within the budget.
+  PREEMPTION — the platform is reclaiming capacity (SIGTERM on a
+               worker, our PreemptedError drain). Also restartable, but
+               counted separately: a preemption storm is capacity
+               pressure, not a bug, and operators read the two numbers
+               differently.
+  FATAL      — a deterministic Python exception in user/model code (a
+               shape error, a NaN guard, an assert). Restarting replays
+               the same failure N more times and burns the budget;
+               fail fast with the classified cause.
+
+This module is import-light BY DESIGN (stdlib only, no jax, no package
+imports): bench.py classifies mid-run backend losses with it before any
+backend exists, and runtime modules can import it without cycles.
+``WorkerError`` is therefore matched structurally (class name + the
+rank/cause attributes runtime/group.py attaches), not by isinstance.
+"""
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Optional
+
+
+class FailureKind:
+    RETRYABLE = "retryable"
+    PREEMPTION = "preemption"
+    FATAL = "fatal"
+
+
+class StallError(RuntimeError):
+    """A worker's heartbeat channel went silent past the stall budget
+    (health.HealthMonitor) — the process is hung, not compiling."""
+
+    def __init__(self, rank: int, silent_s: float, detail: str = ""):
+        self.rank = rank
+        self.silent_s = silent_s
+        msg = (f"worker rank {rank} sent no heartbeat for "
+               f"{silent_s:.0f}s (channel silent — hung, not compiling)")
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
+#: traceback / message markers that mean the *infrastructure* failed —
+#: the job itself never got a verdict. Matched case-sensitively against
+#: the worker traceback or the exception text.
+_RETRYABLE_MARKERS = (
+    "UNAVAILABLE",            # jaxlib XlaRuntimeError: UNAVAILABLE
+    "DEADLINE_EXCEEDED",
+    "coordinator",            # jax.distributed rendezvous failures
+    "Connection reset",
+    "Connection refused",
+    "BrokenPipeError",
+    "backend unavailable",
+    "heartbeat",
+)
+
+#: preemption markers: our own drain exception, plus the signals a
+#: platform reclaim delivers. SIGKILL is deliberately NOT here: a
+#: platform preemption announces itself with SIGTERM first; a bare
+#: SIGKILL is the OOM killer or a hard host failure — restartable, but
+#: drawn from the BOUNDED restart budget (a deterministic memory
+#: overrun must not get max_preemptions' worth of futile replays).
+_PREEMPT_MARKERS = ("PreemptedError", "preemption notice")
+
+_PREEMPT_SIGNALS = ("SIGTERM", "SIGINT", "SIGHUP", "SIGQUIT")
+
+
+@dataclasses.dataclass(frozen=True)
+class FailureClass:
+    """One classified failure: the verdict plus what the operator reads."""
+
+    kind: str                    # FailureKind.*
+    cause: str                   # short slug, e.g. "worker-signal:SIGKILL"
+    rank: Optional[int] = None   # failing rank when known
+    detail: str = ""             # first line of the underlying error
+
+    @property
+    def restartable(self) -> bool:
+        return self.kind != FailureKind.FATAL
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "cause": self.cause, "rank": self.rank,
+                "detail": self.detail}
+
+
+def _first_line(exc: BaseException) -> str:
+    text = str(exc).strip()
+    return text.splitlines()[0][:300] if text else type(exc).__name__
+
+
+def _worker_detail(exc: BaseException) -> str:
+    """For a WorkerError: the last non-empty traceback line — the actual
+    exception repr — not the boilerplate first line."""
+    tb = (getattr(exc, "traceback_str", "") or "").strip()
+    lines = [ln for ln in tb.splitlines() if ln.strip()]
+    return lines[-1][:300] if lines else _first_line(exc)
+
+
+def _looks_like_worker_error(exc: BaseException) -> bool:
+    # structural match (import-light: see module docstring)
+    return (type(exc).__name__ == "WorkerError"
+            and hasattr(exc, "rank") and hasattr(exc, "traceback_str"))
+
+
+def classify_failure(exc: BaseException) -> FailureClass:
+    """Map an exception from a supervised run to a FailureClass."""
+    name = type(exc).__name__
+    text = str(exc)
+
+    if _looks_like_worker_error(exc):
+        rank = getattr(exc, "rank", None)
+        cause = getattr(exc, "cause", "exception")
+        signame = getattr(exc, "signal_name", None)
+        tb = getattr(exc, "traceback_str", "") or ""
+        if signame in _PREEMPT_SIGNALS or any(
+                m in tb for m in _PREEMPT_MARKERS):
+            return FailureClass(
+                FailureKind.PREEMPTION,
+                f"worker-signal:{signame}" if signame else "worker-preempt",
+                rank, _worker_detail(exc))
+        if cause in ("exit", "signal"):
+            # the process vanished without returning a Python verdict —
+            # infra (OOM-killer, node loss, a crashed runtime)
+            slug = (f"worker-signal:{signame}" if signame
+                    else f"worker-exit:{getattr(exc, 'exit_code', None)}")
+            return FailureClass(FailureKind.RETRYABLE, slug, rank,
+                                _worker_detail(exc))
+        if any(m in tb for m in _RETRYABLE_MARKERS):
+            return FailureClass(FailureKind.RETRYABLE, "worker-backend",
+                                rank, _worker_detail(exc))
+        # a real Python traceback out of user/model code: deterministic
+        return FailureClass(FailureKind.FATAL, "worker-exception", rank,
+                            _worker_detail(exc))
+
+    if isinstance(exc, StallError):
+        return FailureClass(FailureKind.RETRYABLE, "stall",
+                            getattr(exc, "rank", None), _first_line(exc))
+    if isinstance(exc, TimeoutError):
+        return FailureClass(FailureKind.RETRYABLE, "timeout", None,
+                            _first_line(exc))
+    if name == "PreemptedError" or any(m in text for m in _PREEMPT_MARKERS):
+        return FailureClass(FailureKind.PREEMPTION, "preempt", None,
+                            _first_line(exc))
+    if name == "BackendUnavailable":
+        # bench.py's bounded init-retry already spent its budget getting
+        # here — retrying the whole run would just double the wait, but
+        # the caller may still carry a restart budget of its own
+        return FailureClass(FailureKind.RETRYABLE, "backend-unavailable",
+                            None, _first_line(exc))
+    if isinstance(exc, (ConnectionError, EOFError, OSError)):
+        return FailureClass(FailureKind.RETRYABLE, "connection", None,
+                            _first_line(exc))
+    if any(m in text for m in _RETRYABLE_MARKERS):
+        return FailureClass(FailureKind.RETRYABLE, "backend", None,
+                            _first_line(exc))
+    return FailureClass(FailureKind.FATAL, f"exception:{name}", None,
+                        _first_line(exc))
+
+
+@dataclasses.dataclass
+class RetryPolicy:
+    """Capped exponential backoff + a restart budget.
+
+    ``max_restarts`` bounds TOTAL restarts across the run (attempt 0 is
+    the original launch). ``preemptions_count`` controls whether
+    PREEMPTION failures draw from the budget — on a preemptible pool a
+    nightly run may legitimately be preempted dozens of times, so the
+    default excludes them (bounded instead by ``max_preemptions``).
+    """
+
+    max_restarts: int = 3
+    backoff_base_s: float = 2.0
+    backoff_factor: float = 2.0
+    backoff_max_s: float = 60.0
+    jitter: float = 0.1          # +- fraction of the delay
+    preemptions_count: bool = False
+    max_preemptions: int = 100
+
+    def next_delay(self, restart_idx: int) -> float:
+        """Delay before restart number ``restart_idx`` (1-based)."""
+        exp = self.backoff_base_s * (
+            self.backoff_factor ** max(0, restart_idx - 1))
+        delay = min(self.backoff_max_s, exp)
+        if self.jitter:
+            delay *= 1.0 + random.uniform(-self.jitter, self.jitter)
+        return max(0.0, delay)
+
+    def allows(self, restarts: int, preemptions: int,
+               failure: FailureClass) -> bool:
+        """True when one more restart is within budget for ``failure``.
+        ``restarts``/``preemptions`` are the counts performed so far,
+        tracked separately by the supervisor."""
+        if not failure.restartable:
+            return False
+        if failure.kind == FailureKind.PREEMPTION:
+            if self.preemptions_count:
+                # preemptions draw from the shared budget: count BOTH
+                # tallies against it (the supervisor increments only
+                # `preemptions` for this kind)
+                return restarts + preemptions < self.max_restarts
+            return preemptions < self.max_preemptions
+        return restarts < self.max_restarts

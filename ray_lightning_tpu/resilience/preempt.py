@@ -1,0 +1,134 @@
+"""Preemption handling: SIGTERM -> emergency checkpoint -> graceful drain.
+
+Production TPU pods are preempted, not stopped: the platform delivers
+SIGTERM (or a metadata preemption notice) and the process has a grace
+window to get its state durable. The async-signal-safe pattern here is
+the one bench.py's kill handlers established: the handler itself does the
+MINIMUM legal work (set a flag, os.write a notice — no allocation, no
+locks, no jax), and the training loop acts on the flag at the next batch
+boundary, where a collective-consistent checkpoint is possible.
+
+Why not checkpoint inside the handler? A signal can land mid-collective:
+calling into jax from the handler could deadlock every rank. All ranks
+receive the platform's SIGTERM (a pod preemption is host-level), so all
+ranks observe their own flag at the same batch boundary and the
+emergency ``save_checkpoint`` below is a valid collective.
+
+The drain raises ``PreemptedError`` after the save; the driver's
+supervisor classifies it PREEMPTION and resumes from the emergency
+checkpoint on fresh capacity.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import Optional, Sequence
+
+from ray_lightning_tpu.core.callbacks import Callback
+from ray_lightning_tpu.utils import get_logger
+
+log = get_logger(__name__)
+
+#: flag state mutated ONLY by the signal handler (single attribute
+#: assignments — atomic w.r.t. the interpreter, safe in a handler)
+_STATE = {"signame": None, "at": None}
+
+
+class PreemptedError(RuntimeError):
+    """Raised by the drain path after the emergency checkpoint landed.
+    The NAME is part of the protocol: it travels to the driver inside the
+    worker traceback and policy.classify_failure keys on it."""
+
+    def __init__(self, signame: str, checkpoint_path: Optional[str]):
+        self.signame = signame
+        self.checkpoint_path = checkpoint_path
+        where = (f"; emergency checkpoint at {checkpoint_path}"
+                 if checkpoint_path else "; no emergency checkpoint")
+        super().__init__(
+            f"training drained after preemption notice ({signame}){where}")
+
+
+def _handler(signum, frame):  # noqa: ARG001 — signal handler shape
+    try:
+        name = signal.Signals(signum).name
+    except ValueError:
+        name = f"signal {signum}"
+    _STATE["signame"] = name
+    _STATE["at"] = time.monotonic()
+    # os.write, not print/logging: allocation-free and re-entrant
+    # (the bench.py kill-handler discipline)
+    os.write(2, f"# preemption notice: {name}\n".encode())
+
+
+def install_preemption_handlers(
+    signals: Sequence[int] = (signal.SIGTERM,),
+) -> None:
+    """Install the flag-only handlers. Idempotent; a non-main thread or
+    an exotic host that refuses leaves the previous disposition."""
+    for sig in signals:
+        try:
+            signal.signal(sig, _handler)
+        except (ValueError, OSError):
+            log.warning("could not install preemption handler for %s", sig)
+
+
+def preemption_requested() -> Optional[str]:
+    """Signal name when a preemption notice arrived, else None."""
+    return _STATE["signame"]
+
+
+def reset_preemption() -> None:
+    _STATE["signame"] = None
+    _STATE["at"] = None
+
+
+class PreemptionGuard(Callback):
+    """Batch-boundary drain: on a pending preemption notice, write an
+    emergency checkpoint (blocking — it must be durable before the grace
+    period expires) and unwind with PreemptedError.
+
+    ``grace_s`` is advisory bookkeeping: the guard logs how much of the
+    platform's window the save consumed, so an operator can see when the
+    grace budget is too tight for the model size.
+    """
+
+    def __init__(self, dirpath: str, grace_s: float = 30.0,
+                 install: bool = True,
+                 signals: Sequence[int] = (signal.SIGTERM,)):
+        self.dirpath = dirpath
+        self.grace_s = grace_s
+        self._install = install
+        self._signals = tuple(signals)
+
+    def on_fit_start(self, trainer, module) -> None:
+        if self._install:
+            install_preemption_handlers(self._signals)
+
+    def _drain(self, trainer) -> None:
+        signame = preemption_requested()
+        if signame is None:
+            return
+        started = _STATE["at"] or time.monotonic()
+        path = os.path.join(
+            self.dirpath, f"preempt-step={trainer.global_step}")
+        ckpt: Optional[str] = None
+        try:
+            # block=True: an async write could still be streaming when
+            # the platform pulls the plug — durability beats latency here
+            ckpt = trainer.save_checkpoint(path, block=True)
+        except Exception:  # noqa: BLE001 — drain anyway; resume falls
+            # back to the previous periodic checkpoint
+            log.exception("emergency checkpoint failed; draining without")
+        used = time.monotonic() - started
+        log.warning(
+            "preemption drain: %s at step %d, emergency save took %.1fs "
+            "of the %.0fs grace window", signame, trainer.global_step,
+            used, self.grace_s)
+        raise PreemptedError(signame, ckpt)
+
+    def on_train_batch_end(self, trainer, module, metrics, batch_idx) -> None:
+        self._drain(trainer)
+
+    def on_train_epoch_end(self, trainer, module) -> None:
+        self._drain(trainer)

@@ -1,0 +1,273 @@
+"""supervise(): the restart loop between the driver API and the runtime.
+
+One supervised attempt is one ordinary ``run_distributed`` call — a fresh
+worker group, launched and torn down by runtime/launch.py exactly as an
+unsupervised run would be. The supervisor adds, around it:
+
+  driver side   classify every failure (policy.classify_failure), sleep
+                the backoff, pick the latest VALID checkpoint
+                (checkpoint.latest_checkpoint — torn/corrupt candidates
+                are skipped), and re-launch with ``ckpt_path`` pointing
+                at it; the trainer's existing mid-epoch resume
+                bookkeeping (core/trainer.py ``_resume_skip_batches``)
+                replays the REST of the interrupted epoch, no batch
+                twice, none skipped. A HealthMonitor rides the queue
+                channel (heartbeats) and the pump's watchdog hook.
+  worker side   the shipped trainer factory is wrapped to attach the
+                periodic step-cadence checkpoint feeding the resume
+                loop, the heartbeat sender, the SIGTERM drain
+                (preempt.PreemptionGuard), and — when configured — the
+                deterministic fault injector.
+
+FATAL failures (a real Python exception in user code) fail fast with the
+classified cause; the underlying WorkerError — rank-tagged, log tail
+attached (runtime/group.py) — stays chained underneath.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal as _signal
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_lightning_tpu.checkpoint import latest_checkpoint
+from ray_lightning_tpu.resilience.health import HealthMonitor, HeartbeatCallback
+from ray_lightning_tpu.resilience.policy import (
+    FailureKind,
+    RetryPolicy,
+    classify_failure,
+)
+from ray_lightning_tpu.utils import get_logger
+
+log = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class ResilienceConfig:
+    """Everything supervise() needs beyond the job itself.
+
+    ``checkpoint_dir`` is the supervisor's OWN durable state: periodic
+    step-cadence saves, preemption emergency saves, and the resume
+    source of truth all live there (keep it distinct from a
+    user ModelCheckpoint's dirpath — the supervisor prunes it).
+    """
+
+    checkpoint_dir: str
+    policy: RetryPolicy = dataclasses.field(default_factory=RetryPolicy)
+    save_every_n_steps: int = 50
+    keep_checkpoints: int = 2       # >= 2: corrupt-latest still resumes
+    heartbeat_interval_s: float = 5.0
+    stall_timeout_s: float = 180.0  # <= 0 disables health monitoring
+    startup_grace_s: float = 600.0
+    preempt_grace_s: float = 30.0
+    resume: str = "auto"            # "auto" | "never": pick up an earlier
+    #                                 run's checkpoints on first launch
+    faults: Optional[str] = None    # fault-plan spec (faults.parse_faults)
+    fault_state_dir: Optional[str] = None  # fire-once markers across
+    #                                 restarts (defaults beside ckpts)
+
+
+@dataclasses.dataclass
+class SupervisedResult:
+    """The job's FitResult plus the supervision ledger."""
+
+    result: Any                     # runtime.fit.FitResult
+    restarts: int                   # retryable restarts performed
+    preemptions: int                # preemption resumes performed
+    failures: List[Dict[str, Any]]  # classified history, launch order
+
+    @property
+    def total_attempts(self) -> int:
+        return 1 + self.restarts + self.preemptions
+
+
+class SupervisedFailure(RuntimeError):
+    """A supervised run that will not be retried: FATAL classification.
+    The original exception (WorkerError with rank + log tail) is chained
+    as __cause__."""
+
+    def __init__(self, classified, attempts: int):
+        self.classified = classified
+        self.attempts = attempts
+        super().__init__(
+            f"supervised run failed FATALLY after {attempts} attempt(s): "
+            f"[{classified.kind}/{classified.cause}"
+            + (f" rank {classified.rank}" if classified.rank is not None
+               else "")
+            + f"] {classified.detail} — restarts will not help; see the "
+              "chained worker error for the rank-tagged traceback and "
+              "log tail")
+
+
+class RestartBudgetExceeded(SupervisedFailure):
+    def __init__(self, classified, attempts: int, budget: int):
+        RuntimeError.__init__(
+            self,
+            f"supervised run still failing after {attempts} attempt(s) "
+            f"(restart budget {budget} exhausted): "
+            f"[{classified.kind}/{classified.cause}] {classified.detail}")
+        self.classified = classified
+        self.attempts = attempts
+
+
+def _wrapped_trainer_factory(trainer_factory: Callable[[], Any],
+                             cfg: ResilienceConfig):
+    """Runs in EVERY worker process (shipped by value via cloudpickle):
+    the user's trainer plus the supervision callbacks."""
+    from ray_lightning_tpu.core.callbacks import ModelCheckpoint
+    from ray_lightning_tpu.resilience.faults import (
+        FaultInjector,
+        faults_from_env,
+        parse_faults,
+    )
+    from ray_lightning_tpu.resilience.preempt import (
+        PreemptionGuard,
+        reset_preemption,
+    )
+
+    trainer = trainer_factory()
+    reset_preemption()  # fresh process; stale flags impossible but cheap
+    has_periodic = any(
+        isinstance(c, ModelCheckpoint)
+        and getattr(c, "dirpath", None) == cfg.checkpoint_dir
+        for c in trainer.callbacks)
+    if not has_periodic:
+        trainer.callbacks.append(ModelCheckpoint(
+            dirpath=cfg.checkpoint_dir, monitor=None,
+            every_n_train_steps=max(1, cfg.save_every_n_steps),
+            save_top_k=max(2, cfg.keep_checkpoints)))
+    if cfg.heartbeat_interval_s > 0:
+        trainer.callbacks.append(
+            HeartbeatCallback(cfg.heartbeat_interval_s))
+    trainer.callbacks.append(PreemptionGuard(
+        cfg.checkpoint_dir, grace_s=cfg.preempt_grace_s,
+        signals=(_signal.SIGTERM,)))
+    faults = parse_faults(cfg.faults) if cfg.faults else faults_from_env()
+    if faults:
+        state_dir = (cfg.fault_state_dir
+                     or os.environ.get("RLT_FAULT_STATE_DIR")
+                     or os.path.join(cfg.checkpoint_dir, ".fault_state"))
+        trainer.callbacks.append(FaultInjector(faults, state_dir))
+    return trainer
+
+
+def supervise(
+    kind: str,
+    module_factory: Callable[[], Any],
+    trainer_factory: Callable[[], Any],
+    data_factory: Callable[[], Any],
+    num_processes: int,
+    *,
+    resilience: ResilienceConfig,
+    **kw: Any,
+) -> SupervisedResult:
+    """Run one distributed job under supervision; returns the job result
+    plus the restart ledger. Accepts every ``run_distributed`` keyword."""
+    from functools import partial
+
+    from ray_lightning_tpu.runtime.fit import run_distributed
+
+    cfg = resilience
+    policy = cfg.policy
+    os.makedirs(cfg.checkpoint_dir, exist_ok=True)
+
+    original_ckpt = kw.pop("ckpt_path", None)
+    ckpt_path = original_ckpt
+    if kind == "fit" and cfg.resume == "auto":
+        found = latest_checkpoint(cfg.checkpoint_dir)
+        if found is not None:
+            log.info("supervise: resuming from earlier run's %s", found)
+            ckpt_path = found
+
+    monitor: Optional[HealthMonitor] = None
+    if (kind == "fit" and cfg.stall_timeout_s > 0
+            and cfg.heartbeat_interval_s > 0):
+        # fit only: HeartbeatCallback starts its sender in on_fit_start,
+        # which the eval-family jobs never fire — a monitor there would
+        # declare a healthy long validate() hung at startup_grace_s
+        monitor = HealthMonitor(
+            num_processes, stall_timeout_s=cfg.stall_timeout_s,
+            startup_grace_s=cfg.startup_grace_s)
+
+    user_q = kw.pop("on_queue_item", None)
+    user_watchdog = kw.pop("watchdog", None)
+
+    def _watchdog() -> None:
+        if monitor is not None:
+            monitor.check()
+        if user_watchdog is not None:
+            user_watchdog()
+
+    def _on_queue_item(rank: int, item: Any) -> None:
+        if monitor is not None and monitor.consume(rank, item):
+            return
+        if user_q is not None:
+            user_q(rank, item)
+        elif callable(item):
+            item()  # the pump trampoline the group would have applied
+        else:
+            log.debug("dropping non-callable queue item from rank %d", rank)
+
+    wrapped_tf = partial(_wrapped_trainer_factory, trainer_factory, cfg)
+
+    restarts = 0
+    preemptions = 0
+    failures: List[Dict[str, Any]] = []
+    while True:
+        if monitor is not None:
+            monitor.reset()
+        attempts = 1 + restarts + preemptions
+        try:
+            result = run_distributed(
+                kind, module_factory, wrapped_tf, data_factory,
+                num_processes,
+                ckpt_path=ckpt_path,
+                on_queue_item=_on_queue_item,
+                watchdog=(_watchdog if (monitor is not None
+                                        or user_watchdog is not None)
+                          else None),
+                **kw,
+            )
+            return SupervisedResult(result, restarts, preemptions, failures)
+        except BaseException as exc:
+            if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+                raise
+            fc = classify_failure(exc)
+            failures.append({"attempt": attempts, **fc.to_dict(),
+                             "at": time.time()})
+            log.warning("supervised attempt %d failed: [%s/%s] %s",
+                        attempts, fc.kind, fc.cause, fc.detail)
+            if fc.kind == FailureKind.FATAL:
+                raise SupervisedFailure(fc, attempts) from exc
+            if not policy.allows(restarts, preemptions, fc):
+                raise RestartBudgetExceeded(
+                    fc, attempts, policy.max_restarts) from exc
+            if fc.kind == FailureKind.PREEMPTION:
+                preemptions += 1
+            else:
+                restarts += 1
+            delay = policy.next_delay(restarts + preemptions)
+            if kind == "fit":
+                found = latest_checkpoint(cfg.checkpoint_dir)
+                ckpt_path = found if found is not None else original_ckpt
+            log.warning(
+                "supervise: restart %d (retryable %d, preemptions %d) in "
+                "%.1fs, resuming from %s", restarts + preemptions,
+                restarts, preemptions, delay, ckpt_path or "scratch")
+            time.sleep(delay)
+
+
+def fit_supervised(
+    module_factory: Callable[[], Any],
+    trainer_factory: Callable[[], Any],
+    data_factory: Callable[[], Any],
+    num_processes: int,
+    *,
+    resilience: ResilienceConfig,
+    **kw: Any,
+) -> SupervisedResult:
+    """Supervised ``fit_distributed``: every transient pod failure becomes
+    a resumed run instead of a lost one. See supervise()."""
+    return supervise("fit", module_factory, trainer_factory, data_factory,
+                     num_processes, resilience=resilience, **kw)

@@ -185,6 +185,8 @@ def run_distributed(
     log_dir: Optional[str] = None,
     hosts: Optional[Sequence[str]] = None,
     transport: Optional[Transport] = None,
+    watchdog: Optional[Callable[[], None]] = None,
+    resilience: Optional[Any] = None,
 ) -> FitResult:
     """Run one Trainer job (`fit|validate|test|predict`) as a multi-process
     SPMD program; return rank 0's results.
@@ -199,9 +201,37 @@ def run_distributed(
 
     ``hosts``/``transport`` place workers on cluster hosts (see
     runtime/transport.py); default is local subprocesses.
+
+    ``resilience=ResilienceConfig(...)`` runs the job under the
+    supervisor (resilience/supervisor.py): transient failures — a
+    SIGTERM'd host, a dropped coordinator, a hung worker — restart the
+    group and resume from the latest valid checkpoint instead of losing
+    the run. Returns the final FitResult; use ``supervise()`` directly
+    when the restart ledger is needed. ``watchdog`` runs ~1 Hz inside
+    the driver's result pump (the stall-monitor hook).
     """
     if kind not in _KINDS:
         raise ValueError(f"kind must be one of {_KINDS}, got {kind!r}")
+    if resilience is not None:
+        # lazy import: resilience imports this module
+        from ray_lightning_tpu.resilience.supervisor import supervise
+
+        supervised = supervise(
+            kind, module_factory, trainer_factory, data_factory,
+            num_processes,
+            resilience=resilience, watchdog=watchdog,
+            module=module, ckpt_path=ckpt_path, platform=platform,
+            num_cpu_devices_per_process=num_cpu_devices_per_process,
+            env=env, init_hook=init_hook, on_queue_item=on_queue_item,
+            return_weights=return_weights, final_ckpt_dir=final_ckpt_dir,
+            timeout=timeout, log_dir=log_dir, hosts=hosts,
+            transport=transport,
+        )
+        if supervised.restarts or supervised.preemptions:
+            log.info("supervised %s finished after %d restart(s) / %d "
+                     "preemption resume(s)", kind, supervised.restarts,
+                     supervised.preemptions)
+        return supervised.result
     results: List[Any] = launch(
         _job_remote,
         num_processes,
@@ -216,6 +246,7 @@ def run_distributed(
         log_dir=log_dir,
         hosts=hosts,
         transport=transport,
+        watchdog=watchdog,
     )
     result = results[0]
     assert isinstance(result, FitResult), (

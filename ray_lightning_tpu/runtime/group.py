@@ -209,13 +209,60 @@ class _HelloAcceptor:
 
 
 class WorkerError(RuntimeError):
-    def __init__(self, rank: int, traceback_str: str, log_tail: str = ""):
+    """A worker failed. ``cause`` classifies HOW (the resilience policy
+    keys on it — see resilience/policy.py):
+
+      "exception" — the worker returned a Python traceback (a real bug
+                    in user/model code; ``traceback_str`` carries it)
+      "signal"    — the process was killed by ``signal_name`` (negative
+                    returncode: SIGKILL'd by the OOM killer, SIGTERM'd
+                    by a preemption, ...)
+      "exit"      — the process exited with ``exit_code`` without
+                    returning a result (a crashed runtime, os._exit)
+
+    The worker's log tail is ALWAYS attached when available, so the user
+    sees *why* rank N vanished instead of a bare "worker died".
+    """
+
+    def __init__(self, rank: int, traceback_str: str, log_tail: str = "",
+                 *, exit_code: Optional[int] = None,
+                 signal_name: Optional[str] = None,
+                 cause: str = "exception"):
         self.rank = rank
         self.traceback_str = traceback_str
+        self.log_tail = log_tail
+        self.exit_code = exit_code
+        self.signal_name = signal_name
+        self.cause = cause
         msg = f"worker rank {rank} failed:\n{traceback_str}"
         if log_tail:
             msg += f"\n--- worker log tail ---\n{log_tail}"
         super().__init__(msg)
+
+    @classmethod
+    def from_death(cls, rank: int, returncode: Optional[int],
+                   log_tail: str, context: str) -> "WorkerError":
+        """Classify a vanished process by its returncode: negative means
+        killed by a signal (name it), non-negative a plain exit."""
+        import signal as _sig
+
+        if returncode is not None and returncode < 0:
+            try:
+                signame = _sig.Signals(-returncode).name
+            except ValueError:
+                signame = f"signal {-returncode}"
+            return cls(
+                rank,
+                f"worker process killed by {signame} (rc={returncode}) "
+                f"{context}",
+                log_tail, exit_code=returncode, signal_name=signame,
+                cause="signal",
+            )
+        return cls(
+            rank,
+            f"worker process exited rc={returncode} {context}",
+            log_tail, exit_code=returncode, cause="exit",
+        )
 
 
 class TpuExecutor:
@@ -470,11 +517,8 @@ class WorkerGroup:
                                 except OSError:
                                     pass
                                 self._abort_start(procs, logs)
-                                raise WorkerError(
-                                    rank,
-                                    f"worker process exited rc={rc} "
-                                    "before connecting",
-                                    tail,
+                                raise WorkerError.from_death(
+                                    rank, rc, tail, "before connecting"
                                 )
                 # Bound the hello read too: a connection that never
                 # speaks must not wedge start().
@@ -566,6 +610,7 @@ class WorkerGroup:
         timeout: Optional[float] = None,
         shared_args: Sequence[Any] = (),
         kwargs: Optional[Dict[str, Any]] = None,
+        watchdog: Optional[Callable[[], None]] = None,
     ) -> List[Any]:
         """Fan ``fn`` out to every rank and pump until all return. Each
         rank executes ``fn(*shared_args, *per_rank_args[rank], **kwargs)``.
@@ -601,7 +646,7 @@ class WorkerGroup:
         # desyncs self-heal.
         resend = {"digest": digest, "blob": blob, "extras": extra_blobs}
         return self.wait(tids, on_queue_item=on_queue_item, timeout=timeout,
-                         resend=resend)
+                         resend=resend, watchdog=watchdog)
 
     def wait(
         self,
@@ -609,7 +654,12 @@ class WorkerGroup:
         on_queue_item: Optional[Callable[[int, Any], None]] = None,
         timeout: Optional[float] = None,
         resend: Optional[Dict[str, Any]] = None,
+        watchdog: Optional[Callable[[], None]] = None,
     ) -> List[Any]:
+        """``watchdog`` runs once per pump slice (~1 Hz) in the driver:
+        the resilience layer's stall monitor raises StallError from it to
+        fail a hung-but-alive worker group (health.HealthMonitor.check).
+        """
         results: Dict[int, Any] = {}
         done: Dict[int, bool] = {r: False for r in range(self.num_workers)}
         deadline = (
@@ -620,6 +670,8 @@ class WorkerGroup:
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError(f"workers still pending: "
                                    f"{[r for r, d in done.items() if not d]}")
+            if watchdog is not None:
+                watchdog()
             ready = conn_wait(list(conns), timeout=1.0)
             if not ready:
                 self._check_liveness(done)
@@ -629,14 +681,31 @@ class WorkerGroup:
                 try:
                     msg = conn.recv()
                 except EOFError:
-                    raise WorkerError(
-                        ex.rank, "worker process died (EOF on channel)",
-                        ex.log_tail(),
-                    ) from None
+                    raise self._eof_error(ex) from None
                 self._dispatch(msg, ex, tids, results, done, on_queue_item,
                                resend)
         self.drain_queue(on_queue_item)
         return [results[r] for r in range(self.num_workers)]
+
+    def _eof_error(self, ex: TpuExecutor) -> WorkerError:
+        """EOF on the channel means the process died (or is dying):
+        harvest its returncode so the death is CLASSIFIED — a SIGKILL'd
+        host reads differently from an os._exit in the resilience policy
+        and in the user's eyes."""
+        try:
+            rc = ex.proc.wait(timeout=2.0)
+        except subprocess.TimeoutExpired:
+            rc = ex.proc.poll()  # channel closed but process lingering
+        if rc is None:
+            return WorkerError(
+                ex.rank,
+                "worker closed its channel but the process is still "
+                "running (EOF on channel)",
+                ex.log_tail(), cause="exit",
+            )
+        return WorkerError.from_death(
+            ex.rank, rc, ex.log_tail(), "(EOF on channel)"
+        )
 
     def run_single(
         self, rank: int, fn: Callable, *args,
@@ -656,20 +725,15 @@ class WorkerGroup:
                 raise TimeoutError(f"rank {rank} still pending")
             if not ex.conn.poll(1.0):
                 if not ex.alive():
-                    raise WorkerError(
-                        ex.rank,
-                        f"worker process exited rc={ex.proc.returncode} "
+                    raise WorkerError.from_death(
+                        ex.rank, ex.proc.returncode, ex.log_tail(),
                         "without returning a result",
-                        ex.log_tail(),
                     )
                 continue
             try:
                 msg = ex.conn.recv()
             except EOFError:
-                raise WorkerError(
-                    ex.rank, "worker process died (EOF on channel)",
-                    ex.log_tail(),
-                ) from None
+                raise self._eof_error(ex) from None
             cmd = msg[0]
             if cmd == "result" and msg[1] == tid:
                 return cloudpickle.loads(msg[2])
@@ -761,11 +825,9 @@ class WorkerGroup:
     def _check_liveness(self, done) -> None:
         for ex in self.executors:
             if not done[ex.rank] and not ex.alive():
-                raise WorkerError(
-                    ex.rank,
-                    f"worker process exited rc={ex.proc.returncode} "
+                raise WorkerError.from_death(
+                    ex.rank, ex.proc.returncode, ex.log_tail(),
                     "without returning a result",
-                    ex.log_tail(),
                 )
 
     # ------------------------------------------------------------ teardown

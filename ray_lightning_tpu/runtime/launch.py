@@ -108,7 +108,22 @@ def _spmd_main(
     if platform:
         jax.config.update("jax_platforms", platform)
     if num_cpu_devices:
-        jax.config.update("jax_num_cpu_devices", num_cpu_devices)
+        try:
+            jax.config.update("jax_num_cpu_devices", num_cpu_devices)
+        except AttributeError:
+            # older jax (< 0.5) has no jax_num_cpu_devices config; the
+            # pre-backend XLA flag is the portable spelling. We run
+            # before any backend init (nothing has touched devices yet),
+            # so the flag is still honored. Strip an inherited count
+            # first — repeated flags must not fight.
+            import re as _re
+
+            flags = os.environ.get("XLA_FLAGS", "")
+            flags = _re.sub(
+                r"--xla_force_host_platform_device_count=\d+", "", flags)
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{num_cpu_devices}")
         # Cross-process CPU collectives ride gloo (the CI fabric; on TPU
         # the fabric is ICI and this knob is untouched).
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
@@ -120,14 +135,22 @@ def _spmd_main(
             num_processes=num_processes,
             process_id=rank,
         )
-    try:
-        return fn(*args, *rank_args, **kwargs)
-    finally:
-        if num_processes > 1:
-            try:
-                jax.distributed.shutdown()
-            except Exception:  # noqa: BLE001 — teardown is best-effort
-                pass
+    result = fn(*args, *rank_args, **kwargs)
+    # Success path ONLY: on an exception the peers may be mid-collective,
+    # and tearing the coordination service out from under them turns one
+    # rank's Python exception into cluster-wide gloo aborts (observed:
+    # EnforceNotMet 'op.preamble.length 16 vs 4' -> SIGABRT on the
+    # healthy rank) while THIS rank blocks in the shutdown barrier —
+    # delaying the very error message the driver's fail-fast
+    # classification needs. The failed group is torn down by the driver
+    # (group.shutdown kills after the grace window), which is the
+    # correct owner of cleanup on the error path.
+    if num_processes > 1:
+        try:
+            jax.distributed.shutdown()
+        except Exception:  # noqa: BLE001 — teardown is best-effort
+            pass
+    return result
 
 
 def launch(
@@ -147,6 +170,7 @@ def launch(
     hosts: Optional[Sequence[str]] = None,
     transport: Optional[Transport] = None,
     coordinator_address: Optional[str] = None,
+    watchdog: Optional[Callable[[], None]] = None,
 ) -> List[Any]:
     """Run ``fn`` on ``num_processes`` host-processes as one SPMD job.
 
@@ -203,6 +227,7 @@ def launch(
             per_rank_args=rank_extras,
             on_queue_item=on_queue_item,
             timeout=timeout,
+            watchdog=watchdog,
         )
     finally:
         group.shutdown()

@@ -30,6 +30,9 @@ class Trial:
         self.checkpoints: List[str] = []   # registered paths, append order
         self.error: Optional[str] = None
         self.result: Any = None            # trainable's return value
+        self.restarts = 0                  # trial-level retries performed
+        #                                    (sweep retry_policy; resumes
+        #                                    from last_checkpoint)
 
     @property
     def iterations(self) -> int:

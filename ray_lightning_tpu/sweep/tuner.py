@@ -289,6 +289,7 @@ class TrialRunner:
         env: Optional[Dict[str, str]],
         hosts: Optional[List[str]] = None,
         transport=None,
+        retry_policy=None,
     ):
         self.trainable = trainable
         self.metric = metric
@@ -301,6 +302,11 @@ class TrialRunner:
         self.trial_timeout = trial_timeout
         self.env = env
         self.transport = transport
+        #: trial-level retry (resilience/policy.py RetryPolicy): an
+        #: infra-classified trial failure re-enqueues the trial, resuming
+        #: from its last registered checkpoint, instead of burying a
+        #: whole config under one flaky host
+        self.retry_policy = retry_policy
         self.host_pool: Optional[_HostPool] = None
         if hosts:
             if transport is None or not transport.is_remote:
@@ -425,6 +431,29 @@ class TrialRunner:
             self._save_trial_state(trial)
             return verdict
 
+    # --------------------------------------------------------------- retry
+    def _retry_delay(self, trial: "Trial",
+                     exc: BaseException) -> Optional[float]:
+        """Backoff delay when this failure should be retried, else None.
+        Reuses the resilience failure taxonomy: FATAL (a deterministic
+        user exception) is never retried — replaying a bug N times would
+        just burn the budget a flaky host needs."""
+        if self.retry_policy is None:
+            return None
+        from ray_lightning_tpu.resilience.policy import classify_failure
+
+        fc = classify_failure(exc)
+        if not fc.restartable or trial.restarts >= self.retry_policy.max_restarts:
+            return None
+        trial.restarts += 1
+        delay = self.retry_policy.next_delay(trial.restarts)
+        log.warning(
+            "trial %s: retry %d/%d in %.1fs after [%s/%s] %s "
+            "(resuming from %s)", trial.trial_id, trial.restarts,
+            self.retry_policy.max_restarts, delay, fc.kind, fc.cause,
+            fc.detail, trial.last_checkpoint or "scratch")
+        return delay
+
     # -------------------------------------------------------------- inline
     def _run_inline(self) -> None:
         for trial in self.trials:
@@ -433,7 +462,17 @@ class TrialRunner:
                          trial.status)
                 self.scheduler.on_trial_complete(trial.trial_id)
                 continue
+            self._run_inline_trial(trial)
+            self.scheduler.on_trial_complete(trial.trial_id)
+            self._save_trial_state(trial)
+
+    def _run_inline_trial(self, trial: "Trial") -> None:
+        import time as _time
+
+        while True:
             trial.status = Trial.RUNNING
+            # rebuilt per attempt: a retry must resume from the LAST
+            # registered checkpoint, not the one the first attempt saw
             ctx = trial_session.LocalTrialContext(
                 trial.trial_id, trial.trial_dir, self._handle_report,
                 last_checkpoint=trial.last_checkpoint,
@@ -446,15 +485,18 @@ class TrialRunner:
             os.environ["RLT_TRIAL_DIR"] = trial.trial_dir
             if trial.last_checkpoint:
                 os.environ["RLT_TRIAL_RESUME"] = trial.last_checkpoint
+            retry_in: Optional[float] = None
             try:
                 trial.result = self.trainable(trial.config)
                 trial.status = Trial.DONE
             except trial_session.TrialStopped:
                 trial.status = Trial.STOPPED
             except BaseException as exc:  # noqa: BLE001 — recorded per trial
-                trial.status = Trial.ERROR
-                trial.error = traceback.format_exc()
-                log.error("trial %s failed: %s", trial.trial_id, exc)
+                retry_in = self._retry_delay(trial, exc)
+                if retry_in is None:
+                    trial.status = Trial.ERROR
+                    trial.error = traceback.format_exc()
+                    log.error("trial %s failed: %s", trial.trial_id, exc)
             finally:
                 trial_session.reset_trial_session()
                 for k, v in saved_env.items():
@@ -462,8 +504,9 @@ class TrialRunner:
                         os.environ.pop(k, None)
                     else:
                         os.environ[k] = v
-                self.scheduler.on_trial_complete(trial.trial_id)
-                self._save_trial_state(trial)
+            if retry_in is None:
+                return
+            _time.sleep(retry_in)
 
     # ------------------------------------------------------------- process
     def _run_process(self) -> None:
@@ -499,7 +542,8 @@ class TrialRunner:
                         trial.status = Trial.RUNNING
                         threading.Thread(
                             target=self._trial_thread,
-                            args=(trial, server, running, trial_hosts),
+                            args=(trial, server, running, trial_hosts,
+                                  pending),
                             daemon=True,
                         ).start()
                     self._cond.wait(timeout=1.0)
@@ -507,8 +551,10 @@ class TrialRunner:
             server.close()
 
     def _trial_thread(self, trial: Trial, server: _ReportServer,
-                      running: set, trial_hosts=None) -> None:
+                      running: set, trial_hosts=None,
+                      pending: Optional[deque] = None) -> None:
         group = None
+        retry_in: Optional[float] = None
         try:
             env = {**(self.env or {}),
                    "RLT_TRIAL_ID": trial.trial_id,
@@ -538,24 +584,39 @@ class TrialRunner:
             )
             trial.status, trial.result = out
         except WorkerError as exc:
-            trial.status = Trial.ERROR
-            trial.error = exc.traceback_str
-            log.error("trial %s failed:\n%s", trial.trial_id,
-                      exc.traceback_str)
-        except BaseException:  # noqa: BLE001 — recorded per trial
-            trial.status = Trial.ERROR
-            trial.error = traceback.format_exc()
-            log.error("trial %s infra failure:\n%s", trial.trial_id,
-                      trial.error)
+            retry_in = self._retry_delay(trial, exc)
+            if retry_in is None:
+                trial.status = Trial.ERROR
+                trial.error = exc.traceback_str
+                log.error("trial %s failed:\n%s", trial.trial_id,
+                          exc.traceback_str)
+        except BaseException as exc:  # noqa: BLE001 — recorded per trial
+            retry_in = self._retry_delay(trial, exc)
+            if retry_in is None:
+                trial.status = Trial.ERROR
+                trial.error = traceback.format_exc()
+                log.error("trial %s infra failure:\n%s", trial.trial_id,
+                          trial.error)
         finally:
             if group is not None:
                 group.shutdown()
             self.pool.release(self.resources)
             if trial_hosts and self.host_pool is not None:
                 self.host_pool.release(trial_hosts)
-            self.scheduler.on_trial_complete(trial.trial_id)
+            if retry_in is None:
+                # terminal outcome only — a retried trial is not complete
+                self.scheduler.on_trial_complete(trial.trial_id)
             self._save_trial_state(trial)
+            if retry_in is not None:
+                # resources are released; the backoff costs only this
+                # daemon thread and one concurrency slot
+                import time as _time
+
+                _time.sleep(retry_in)
             with self._cond:
+                if retry_in is not None and pending is not None:
+                    trial.status = Trial.PENDING
+                    pending.append(trial)
                 running.discard(trial.trial_id)
                 self._cond.notify_all()
 
@@ -591,6 +652,7 @@ def run(
     transport=None,
     seed: int = 0,
     raise_on_failed_trial: bool = True,
+    retry_policy=None,
 ) -> ExperimentAnalysis:
     """``tune.run`` analog (reference examples/ray_ddp_example.py:101-113).
 
@@ -609,6 +671,12 @@ def run(
     the reference's "Tune schedules trial actors anywhere" capability;
     concurrency is additionally bounded by ``len(hosts) //
     resources_per_trial.hosts``. Ignored by the inline executor.
+
+    ``retry_policy`` (resilience.RetryPolicy) retries trials whose
+    failure classifies as infrastructure (a killed worker process, a
+    timeout, a backend loss) up to ``max_restarts`` times with capped
+    exponential backoff, resuming from the trial's last registered
+    checkpoint; FATAL user exceptions still fail the trial immediately.
     """
     if mode not in ("min", "max"):
         raise ValueError("mode must be 'min' or 'max'")
@@ -637,7 +705,7 @@ def run(
         resources_per_trial=resources_per_trial, pool=pool,
         max_concurrent=max_concurrent, storage_dir=storage_dir,
         executor=executor, trial_timeout=trial_timeout, env=env,
-        hosts=hosts, transport=transport,
+        hosts=hosts, transport=transport, retry_policy=retry_policy,
     )
     log.info("sweep %s: %d trials, <=%d concurrent, %d chips/trial of %d",
              name, len(runner.trials), runner.max_concurrent,

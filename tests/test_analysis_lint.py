@@ -271,3 +271,119 @@ def test_tpumodule_lint_classmethod():
     from ray_lightning_tpu.models.llama import LlamaModule
 
     assert LlamaModule.lint() == []
+
+
+# ---- RLT401 resilience anti-patterns (ISSUE 3 satellite) -----------------
+
+
+def test_rlt401_swallowed_worker_error_fires():
+    fs = lint(
+        "from ray_lightning_tpu.runtime import fit_distributed\n"
+        "def train(mf, tf, df):\n"
+        "    try:\n"
+        "        fit_distributed(mf, tf, df, 4)\n"
+        "    except Exception:\n"
+        "        pass\n")
+    assert rules_of(fs) == ["RLT401"]
+    assert "swallows" in fs[0].message
+
+
+def test_rlt401_bare_except_and_group_method_forms():
+    fs = lint(
+        "def run(group):\n"
+        "    try:\n"
+        "        group.run(lambda: 1)\n"
+        "    except:\n"
+        "        pass\n")
+    assert rules_of(fs) == ["RLT401"]
+    fs = lint(
+        "from ray_lightning_tpu.runtime import WorkerError\n"
+        "def run(g):\n"
+        "    try:\n"
+        "        g.do_stuff()\n"
+        "    except WorkerError:\n"
+        "        continue_anyway = None\n"
+        "        pass\n")
+    # handler body is NOT trivial (assignment) -> quiet
+    assert fs == []
+
+
+def test_rlt401_quiet_on_handled_or_unrelated_excepts():
+    # re-raised: not swallowed
+    fs = lint(
+        "from ray_lightning_tpu.runtime import fit_distributed\n"
+        "def train(mf, tf, df):\n"
+        "    try:\n"
+        "        fit_distributed(mf, tf, df, 4)\n"
+        "    except Exception:\n"
+        "        log.error('boom')\n"
+        "        raise\n")
+    assert fs == []
+    # broad except-pass around NON-worker code: quiet (that is ruff's
+    # turf, not a supervision defeat)
+    fs = lint(
+        "def parse(x):\n"
+        "    try:\n"
+        "        return int(x)\n"
+        "    except Exception:\n"
+        "        pass\n")
+    assert fs == []
+
+
+def test_rlt401_worker_group_without_shutdown_fires():
+    fs = lint(
+        "from ray_lightning_tpu.runtime import WorkerGroup\n"
+        "def launch_all():\n"
+        "    g = WorkerGroup(4)\n"
+        "    g.start()\n"
+        "    return g.run(lambda: 1)\n")
+    assert rules_of(fs) == ["RLT401"]
+    assert "shutdown" in fs[0].message
+    # chained start form
+    fs = lint(
+        "def launch_all():\n"
+        "    g = WorkerGroup(4).start()\n"
+        "    g.run(lambda: 1)\n")
+    assert rules_of(fs) == ["RLT401"]
+
+
+def test_rlt401_quiet_on_managed_worker_groups():
+    # with-managed
+    fs = lint(
+        "def a(tmp):\n"
+        "    g = WorkerGroup(2)\n"
+        "    with g:\n"
+        "        g.run(fn)\n")
+    assert fs == []
+    # try/finally shutdown (even conditional, the repo's tuner idiom)
+    fs = lint(
+        "def b():\n"
+        "    g = None\n"
+        "    try:\n"
+        "        g = WorkerGroup(2)\n"
+        "        g.start()\n"
+        "        g.run(fn)\n"
+        "    finally:\n"
+        "        if g is not None:\n"
+        "            g.shutdown()\n")
+    assert fs == []
+    # ownership handed away: factory returns the started group
+    fs = lint(
+        "def make():\n"
+        "    g = WorkerGroup(2)\n"
+        "    g.start()\n"
+        "    return g\n")
+    assert fs == []
+    # never started: nothing leaked
+    fs = lint(
+        "def c():\n"
+        "    g = WorkerGroup(2)\n")
+    assert fs == []
+
+
+def test_rlt401_suppressible():
+    fs = lint(
+        "def launch_all():\n"
+        "    g = WorkerGroup(4)  # rlt: disable=RLT401\n"
+        "    g.start()\n")
+    assert fs == []

@@ -1,0 +1,575 @@
+"""Resilience subsystem: failure taxonomy, retry policy, fault injection,
+preemption drain, health monitoring, checkpoint validity, and the
+supervised restart loop (docs/RESILIENCE.md; ISSUE 3).
+
+Fast tests run in-process (the taxonomy, the injector, the guard, the
+monitor, checkpoint verification, and a full deterministic
+kill-at-step-J + resume at Trainer level). The @slow tests drive REAL
+2-process SPMD groups through supervise() — worker kill, coordinator
+drop, corrupt-latest-checkpoint, SIGTERM preemption, and FATAL
+fail-fast — the acceptance matrix of the issue.
+"""
+import json
+import os
+import signal as _signal
+import time
+
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.resilience.policy import (
+    FailureKind,
+    RetryPolicy,
+    StallError,
+    classify_failure,
+)
+from ray_lightning_tpu.runtime.group import WorkerError
+
+# ---------------------------------------------------------------- policy
+
+
+def test_classify_sigterm_as_preemption_sigkill_as_retryable():
+    term = WorkerError.from_death(2, -15, "tail", "(EOF on channel)")
+    assert term.cause == "signal" and term.signal_name == "SIGTERM"
+    fc = classify_failure(term)
+    assert fc.kind == FailureKind.PREEMPTION and fc.rank == 2
+    assert "SIGTERM" in fc.cause
+    # SIGKILL announces no grace window: OOM killer / hard host failure —
+    # restartable, but from the BOUNDED budget, never the preemption one
+    kill = WorkerError.from_death(2, -9, "tail", "(EOF on channel)")
+    fc = classify_failure(kill)
+    assert fc.kind == FailureKind.RETRYABLE
+    assert "SIGKILL" in fc.cause
+
+
+def test_classify_plain_exit_as_retryable():
+    err = WorkerError.from_death(1, 7, "", "without returning a result")
+    assert err.cause == "exit" and err.exit_code == 7
+    fc = classify_failure(err)
+    assert fc.kind == FailureKind.RETRYABLE
+    assert fc.restartable
+
+
+def test_classify_user_traceback_as_fatal():
+    err = WorkerError(0, "Traceback (most recent call last):\n"
+                         "  ...\nValueError: shapes do not match")
+    fc = classify_failure(err)
+    assert fc.kind == FailureKind.FATAL
+    assert not fc.restartable
+    assert "ValueError" in fc.detail  # the last traceback line, not the
+    #                                   "worker rank 0 failed" boilerplate
+
+
+def test_classify_backend_loss_in_worker_as_retryable():
+    err = WorkerError(3, "Traceback ...\njaxlib.xla_extension."
+                         "XlaRuntimeError: UNAVAILABLE: socket closed")
+    assert classify_failure(err).kind == FailureKind.RETRYABLE
+
+
+def test_classify_preempted_drain_as_preemption():
+    err = WorkerError(1, "Traceback ...\nray_lightning_tpu.resilience."
+                         "preempt.PreemptedError: training drained after "
+                         "preemption notice (SIGTERM)")
+    assert classify_failure(err).kind == FailureKind.PREEMPTION
+
+
+def test_classify_driver_side_exceptions():
+    assert classify_failure(TimeoutError("pending")).kind == \
+        FailureKind.RETRYABLE
+    assert classify_failure(StallError(1, 200.0)).kind == \
+        FailureKind.RETRYABLE
+    assert classify_failure(ValueError("bad config")).kind == \
+        FailureKind.FATAL
+
+
+def test_retry_policy_backoff_caps_and_budget():
+    p = RetryPolicy(max_restarts=2, backoff_base_s=1.0, backoff_factor=4.0,
+                    backoff_max_s=5.0, jitter=0.0)
+    assert p.next_delay(1) == 1.0
+    assert p.next_delay(2) == 4.0
+    assert p.next_delay(3) == 5.0  # capped
+    retry = classify_failure(TimeoutError("x"))
+    preempt = classify_failure(WorkerError.from_death(0, -15, "", "ctx"))
+    fatal = classify_failure(ValueError("x"))
+    assert p.allows(0, 0, retry) and p.allows(1, 0, retry)
+    assert not p.allows(2, 0, retry)          # budget spent
+    assert not p.allows(0, 0, fatal)          # never
+    # preemptions have their own (large) budget by default
+    assert p.allows(2, 0, preempt)
+    strict = RetryPolicy(max_restarts=1, preemptions_count=True)
+    assert not strict.allows(1, 0, preempt)
+
+
+# ---------------------------------------------------------------- faults
+
+
+def test_parse_faults_roundtrip_and_errors():
+    from ray_lightning_tpu.resilience.faults import parse_faults
+
+    faults = parse_faults("kill:rank=1,step=3; preempt:rank=*,step=2;"
+                          "corrupt_latest:rank=0,step=4,dir=/tmp/ck")
+    assert [f.kind for f in faults] == ["kill", "preempt", "corrupt_latest"]
+    assert faults[0].rank == 1 and faults[0].step == 3
+    assert faults[1].rank is None  # "*"
+    assert faults[2].args["dir"] == "/tmp/ck"
+    assert faults[0].matches(1, 3) and not faults[0].matches(0, 3)
+    assert not faults[0].matches(1, 2)
+    assert parse_faults(None) == [] and parse_faults("") == []
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        parse_faults("explode:rank=0,step=1")
+    with pytest.raises(ValueError, match="malformed fault arg"):
+        parse_faults("kill:rank")
+
+
+def test_fault_injector_fires_once_across_restarts(tmp_path):
+    """The marker is written BEFORE the fault fires, so a restarted run
+    (same state dir) sails past the step that killed its predecessor."""
+    from ray_lightning_tpu.resilience.faults import Fault, FaultInjector
+
+    state = str(tmp_path / "fault_state")
+
+    class _T:
+        global_step = 3
+
+    inj = FaultInjector([Fault("raise", None, 3, {}, index=0)], state)
+    with pytest.raises(RuntimeError, match="injected fatal failure"):
+        inj.on_train_batch_end(_T(), None, {}, 0)
+    # a FRESH injector (new process after restart) sees the marker
+    inj2 = FaultInjector([Fault("raise", None, 3, {}, index=0)], state)
+    inj2.on_train_batch_end(_T(), None, {}, 0)  # no raise
+
+
+def test_corrupt_checkpoint_flips_state_not_meta(tmp_path):
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.checkpoint import (
+        save_checkpoint,
+        verify_checkpoint,
+    )
+    from ray_lightning_tpu.resilience.faults import corrupt_checkpoint
+
+    path = str(tmp_path / "ck")
+    save_checkpoint(path, {"w": jnp.arange(1024, dtype=jnp.float32)},
+                    {"global_step": 5})
+    ok, _ = verify_checkpoint(path)
+    assert ok
+    assert corrupt_checkpoint(path)
+    ok, reason = verify_checkpoint(path)
+    assert not ok and "digest mismatch" in reason
+    # meta.json survived: the checkpoint looks FINISHED but damaged —
+    # exactly the case the digest exists to catch
+    assert os.path.exists(os.path.join(path, "meta.json"))
+
+
+# ----------------------------------------------------------- checkpoints
+
+
+def test_latest_checkpoint_skips_torn_and_corrupt(tmp_path):
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.checkpoint import (
+        latest_checkpoint,
+        save_checkpoint,
+    )
+    from ray_lightning_tpu.resilience.faults import corrupt_checkpoint
+
+    root = tmp_path / "ckpts"
+    for step in (1, 2, 3):
+        save_checkpoint(str(root / f"step={step}"),
+                        {"w": jnp.full((16,), float(step))},
+                        {"global_step": step})
+    # newest (step=3) corrupted, step=2 torn (meta never finalized)
+    corrupt_checkpoint(str(root / "step=3"))
+    os.remove(root / "step=2" / "meta.json")
+    assert latest_checkpoint(str(root)) == str(root / "step=1")
+    # all invalid -> None (resume from scratch, not from garbage)
+    corrupt_checkpoint(str(root / "step=1"))
+    assert latest_checkpoint(str(root)) is None
+    assert latest_checkpoint(str(tmp_path / "missing")) is None
+
+
+def test_latest_checkpoint_orders_by_step_not_name(tmp_path):
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.checkpoint import (
+        latest_checkpoint,
+        save_checkpoint,
+    )
+
+    root = tmp_path / "ckpts"
+    for step in (9, 10):  # lexicographic would pick "step=9"
+        save_checkpoint(str(root / f"step={step}"),
+                        {"w": jnp.zeros((4,))}, {"global_step": step})
+    assert latest_checkpoint(str(root)) == str(root / "step=10")
+
+
+def test_meta_json_written_atomically(tmp_path):
+    """No .tmp residue and a parseable meta with digest fields."""
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.checkpoint import save_checkpoint
+
+    path = str(tmp_path / "ck")
+    save_checkpoint(path, {"w": jnp.ones((8,))}, {"global_step": 1})
+    assert not os.path.exists(os.path.join(path, "meta.json.tmp"))
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    assert meta["ckpt_digest_mode"] == "full"
+    assert len(meta["ckpt_digest"]) == 64 and meta["ckpt_files"] >= 1
+
+
+# -------------------------------------------------------------- preempt
+
+
+def test_preemption_flag_and_guard_drain(tmp_path):
+    """SIGTERM -> flag only (async-signal-safe); the guard drains at the
+    next batch boundary: emergency checkpoint (valid!) then
+    PreemptedError."""
+    from ray_lightning_tpu import DataLoader, SingleDevice, Trainer
+    from ray_lightning_tpu.checkpoint import (
+        latest_checkpoint,
+        verify_checkpoint,
+    )
+    from ray_lightning_tpu.resilience.preempt import (
+        PreemptedError,
+        PreemptionGuard,
+        install_preemption_handlers,
+        preemption_requested,
+        reset_preemption,
+    )
+    from tests.utils import BoringModel, random_dataset
+
+    old = _signal.getsignal(_signal.SIGTERM)
+    try:
+        install_preemption_handlers()
+        assert preemption_requested() is None
+        os.kill(os.getpid(), _signal.SIGTERM)
+        assert preemption_requested() == "SIGTERM"
+
+        ck = str(tmp_path / "ck")
+        trainer = Trainer(strategy=SingleDevice(), max_epochs=1,
+                          enable_checkpointing=False,
+                          enable_progress_bar=False,
+                          callbacks=[PreemptionGuard(ck, install=False)],
+                          default_root_dir=str(tmp_path), seed=0)
+        with pytest.raises(PreemptedError) as exc_info:
+            trainer.fit(BoringModel(),
+                        DataLoader(random_dataset(), batch_size=32))
+        assert exc_info.value.checkpoint_path is not None
+        ok, reason = verify_checkpoint(exc_info.value.checkpoint_path)
+        assert ok, reason
+        assert latest_checkpoint(ck) == exc_info.value.checkpoint_path
+    finally:
+        reset_preemption()
+        _signal.signal(_signal.SIGTERM, old)
+
+
+# --------------------------------------------------------------- health
+
+
+def test_health_monitor_distinguishes_compiling_from_hung():
+    from ray_lightning_tpu.resilience.health import (
+        HealthMonitor,
+        make_heartbeat,
+    )
+
+    mon = HealthMonitor(num_workers=2, stall_timeout_s=10.0,
+                        startup_grace_s=30.0, step_stall_note_s=5.0)
+    now = time.monotonic()
+    assert mon.consume(0, make_heartbeat(0, step=1))
+    assert mon.consume(1, make_heartbeat(1, step=1))
+    assert not mon.consume(0, {"some": "other item"})
+    mon.check(now)  # healthy
+    # live channel, frozen step: NOT a stall (compiling) — check passes
+    mon.consume(0, make_heartbeat(0, step=1))
+    mon.check(now + 8.0)
+    # silent channel past the budget: hung
+    with pytest.raises(StallError, match="rank 0"):
+        mon.check(now + 11.0)
+
+
+def test_health_monitor_startup_grace():
+    from ray_lightning_tpu.resilience.health import (
+        HealthMonitor,
+        make_heartbeat,
+    )
+
+    mon = HealthMonitor(num_workers=2, stall_timeout_s=30.0,
+                        startup_grace_s=20.0)
+    now = time.monotonic()
+    mon.consume(0, make_heartbeat(0, step=0))
+    mon.check(now + 19.0)  # rank 1 silent but inside the startup grace
+    with pytest.raises(StallError, match="never reached"):
+        mon.check(now + 21.0)  # rank 0 (21s < 30s budget) is fine;
+        #                        rank 1 never started -> grace expired
+
+
+# ------------------------------------------- deterministic resume (fast)
+
+
+class _MetricRecorder:
+    """Collects per-batch id sums so replay/skip is provable."""
+
+    def __init__(self):
+        from ray_lightning_tpu import Callback
+
+        class _CB(Callback):
+            def __init__(cb):
+                cb.id_sums = []
+
+            def on_train_batch_end(cb, trainer, module, metrics, batch_idx):
+                cb.id_sums.append(float(np.asarray(metrics["id_sum"])))
+
+        self.cb = _CB()
+
+
+def _idsum_loader():
+    from ray_lightning_tpu import DataLoader
+
+    rng = np.random.default_rng(0)
+    x = np.zeros((64, 8), np.float32)
+    x[:, 0] = np.arange(64)
+    y = rng.integers(0, 2, 64).astype(np.int32)
+    return DataLoader({"x": x, "y": y}, batch_size=8, shuffle=True, seed=3)
+
+
+def test_kill_at_step_j_resume_is_deterministic(tmp_path):
+    """Train 16 steps straight vs raise-at-step-3 (faults.py) + resume
+    from latest_checkpoint: final params BITWISE identical, every batch
+    trained exactly once (id accounting) — pins _resume_skip_batches
+    under a real restart-shaped interruption."""
+    import jax
+
+    from ray_lightning_tpu import SingleDevice, Trainer
+    from ray_lightning_tpu.checkpoint import latest_checkpoint
+    from ray_lightning_tpu.core.callbacks import ModelCheckpoint
+    from ray_lightning_tpu.resilience.faults import Fault, FaultInjector
+    from tests.utils import IdSumModel
+
+    def trainer(root, extra):
+        return Trainer(strategy=SingleDevice(), max_epochs=2,
+                       enable_checkpointing=False,
+                       enable_progress_bar=False, seed=7,
+                       default_root_dir=str(root), callbacks=extra)
+
+    # --- run A: uninterrupted
+    rec_a = _MetricRecorder()
+    mod_a = IdSumModel(lr=1e-2)
+    trainer(tmp_path / "a", [rec_a.cb]).fit(mod_a, _idsum_loader())
+    assert len(rec_a.cb.id_sums) == 16  # 8 batches/epoch x 2
+
+    # --- run B: checkpoint every step, die at step 3, auto-resume
+    ck = str(tmp_path / "ck")
+    state = str(tmp_path / "fault_state")
+    rec_b = _MetricRecorder()
+    mc = ModelCheckpoint(dirpath=ck, monitor=None,
+                         every_n_train_steps=1, save_top_k=-1)
+    inj = FaultInjector([Fault("raise", None, 3, {}, index=0)], state)
+    mod_b1 = IdSumModel(lr=1e-2)
+    with pytest.raises(RuntimeError, match="injected fatal failure"):
+        trainer(tmp_path / "b1", [mc, rec_b.cb, inj]).fit(
+            mod_b1, _idsum_loader())
+    resume_from = latest_checkpoint(ck)
+    assert resume_from is not None and resume_from.endswith("step=3")
+
+    mod_b2 = IdSumModel(lr=1e-2)
+    inj2 = FaultInjector([Fault("raise", None, 3, {}, index=0)], state)
+    t_b2 = trainer(tmp_path / "b2", [rec_b.cb, inj2])
+    t_b2.fit(mod_b2, _idsum_loader(), ckpt_path=resume_from)
+
+    # no batch replayed, none skipped: 3 + 13 = 16 sums, totals equal
+    assert len(rec_b.cb.id_sums) == 16
+    assert sum(rec_b.cb.id_sums) == sum(rec_a.cb.id_sums) \
+        == 2 * sum(range(64))
+    # the two halves cover the same batch sequence as the straight run
+    assert rec_b.cb.id_sums == rec_a.cb.id_sums
+    # final params identical, bitwise
+    for a, b in zip(jax.tree.leaves(jax.device_get(mod_a.params)),
+                    jax.tree.leaves(jax.device_get(mod_b2.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert t_b2.global_step == 16
+
+
+# -------------------------------------------------------- sweep retry
+
+
+def test_sweep_trial_retry_resumes_on_infra_failure(tmp_path):
+    """Trial-level retry (same taxonomy): an infra-classified failure
+    re-runs the trial; a FATAL user exception still fails it."""
+    from ray_lightning_tpu import sweep
+
+    flaky_marker = str(tmp_path / "first_attempt_done")
+
+    def flaky(config):
+        if not os.path.exists(flaky_marker):
+            with open(flaky_marker, "w") as f:
+                f.write("1")
+            raise TimeoutError("transient infra loss")
+        sweep.report(loss=0.1)
+        return {"ok": True}
+
+    analysis = sweep.run(
+        flaky, {}, executor="inline", metric="loss", mode="min",
+        storage_dir=str(tmp_path / "s1"), total_chips=1,
+        retry_policy=RetryPolicy(max_restarts=2, backoff_base_s=0.0,
+                                 jitter=0.0),
+    )
+    [trial] = analysis.trials
+    assert trial.status == "done" and trial.restarts == 1
+
+    def fatal(config):
+        raise ValueError("a real bug")
+
+    analysis = sweep.run(
+        fatal, {}, executor="inline", storage_dir=str(tmp_path / "s2"),
+        total_chips=1, raise_on_failed_trial=False,
+        retry_policy=RetryPolicy(max_restarts=2, backoff_base_s=0.0,
+                                 jitter=0.0),
+    )
+    [trial] = analysis.trials
+    assert trial.status == "error" and trial.restarts == 0
+
+
+# ----------------------------------------- supervised SPMD runs (slow)
+
+
+def _sup_module():
+    from tests.utils import IdSumModel
+
+    return IdSumModel(lr=1e-2)
+
+
+def _sup_trainer():
+    from ray_lightning_tpu import DataParallel, Trainer
+
+    return Trainer(strategy=DataParallel(), max_epochs=2,
+                   enable_progress_bar=False, enable_checkpointing=False,
+                   seed=0)
+
+
+def _sup_data():
+    import jax
+
+    from ray_lightning_tpu import DataLoader
+
+    rng = np.random.default_rng(0)
+    x = np.zeros((64, 8), np.float32)
+    x[:, 0] = np.arange(64)
+    y = rng.integers(0, 2, 64).astype(np.int32)
+    return DataLoader({"x": x, "y": y}, batch_size=8,
+                      num_shards=jax.process_count(),
+                      shard_index=jax.process_index())
+
+
+def _resilience(tmp_path, name, faults=None, max_restarts=2):
+    from ray_lightning_tpu import ResilienceConfig
+
+    return ResilienceConfig(
+        checkpoint_dir=str(tmp_path / name),
+        policy=RetryPolicy(max_restarts=max_restarts, backoff_base_s=0.2,
+                           jitter=0.0),
+        save_every_n_steps=1,
+        heartbeat_interval_s=1.0,
+        stall_timeout_s=0.0,  # liveness covers these tests; the stall
+        #                       path has its own unit coverage
+        faults=faults,
+    )
+
+
+_SPMD = dict(num_processes=2, platform="cpu",
+             num_cpu_devices_per_process=1, timeout=420)
+
+
+def _supervised_params(tmp_path, name, faults):
+    from ray_lightning_tpu import fit_supervised
+
+    module = _sup_module()
+    supervised = fit_supervised(
+        _sup_module, _sup_trainer, _sup_data, module=module,
+        resilience=_resilience(tmp_path, name, faults),
+        log_dir=str(tmp_path / f"logs_{name}"), **_SPMD,
+    )
+    assert module.params is not None
+    return supervised, module
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("victim", [1, 0])  # 0 = the coordinator rank
+def test_supervise_worker_kill_autoresumes(tmp_path, victim):
+    """A SIGKILL'd worker (rank 1) / the dropped coordinator (rank 0) at
+    step 2: the supervisor relaunches and resumes; the final params are
+    IDENTICAL to an uninterrupted supervised run — nothing replayed,
+    nothing skipped, optimizer state included."""
+    import jax
+
+    base, base_mod = _supervised_params(tmp_path, "base", faults=None)
+    assert base.total_attempts == 1
+
+    killed, killed_mod = _supervised_params(
+        tmp_path, f"kill{victim}", faults=f"kill:rank={victim},step=2")
+    assert killed.total_attempts == 2
+    [failure] = killed.failures
+    assert failure["kind"] == "retryable"   # SIGKILL = OOM-kill/host loss
+    assert "SIGKILL" in failure["cause"]
+    for a, b in zip(jax.tree.leaves(base_mod.params),
+                    jax.tree.leaves(killed_mod.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow
+def test_supervise_skips_corrupt_latest_checkpoint(tmp_path):
+    """corrupt-latest + kill in the same step: resume must come from the
+    last VALID checkpoint (step=1), and the run still converges to the
+    uninterrupted result."""
+    import jax
+
+    base, base_mod = _supervised_params(tmp_path, "base", faults=None)
+    hurt, hurt_mod = _supervised_params(
+        tmp_path, "corrupt",
+        faults="corrupt_latest:rank=0,step=2,dir={d};kill:rank=0,step=2"
+        .format(d=str(tmp_path / "corrupt")))
+    assert hurt.total_attempts == 2
+    for a, b in zip(jax.tree.leaves(base_mod.params),
+                    jax.tree.leaves(hurt_mod.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow
+def test_supervise_sigterm_emergency_checkpoint_and_drain(tmp_path):
+    """SIGTERM during training: flag-only handler, batch-boundary
+    emergency save, PreemptedError drain, PREEMPTION-classified resume."""
+    from ray_lightning_tpu.checkpoint import verify_checkpoint
+
+    sup, _ = _supervised_params(tmp_path, "pre",
+                                faults="preempt:rank=*,step=2")
+    assert sup.preemptions == 1 and sup.restarts == 0
+    [failure] = sup.failures
+    assert failure["kind"] == "preemption"
+    emergency = [d for d in os.listdir(tmp_path / "pre")
+                 if d.startswith("preempt-step=")]
+    assert emergency, "no emergency checkpoint was written"
+    ok, reason = verify_checkpoint(str(tmp_path / "pre" / emergency[0]))
+    assert ok, reason
+
+
+@pytest.mark.slow
+def test_supervise_fatal_fails_fast_with_classified_cause(tmp_path):
+    """A deterministic user exception: NO restarts; the SupervisedFailure
+    names the classification and chains the rank-tagged WorkerError."""
+    from ray_lightning_tpu import fit_supervised
+    from ray_lightning_tpu.resilience.supervisor import SupervisedFailure
+
+    with pytest.raises(SupervisedFailure) as exc_info:
+        fit_supervised(
+            _sup_module, _sup_trainer, _sup_data,
+            resilience=_resilience(tmp_path, "fatal",
+                                   faults="raise:rank=0,step=2"),
+            log_dir=str(tmp_path / "logs_fatal"), **_SPMD,
+        )
+    exc = exc_info.value
+    assert exc.classified.kind == FailureKind.FATAL
+    assert exc.attempts == 1
+    cause = exc.__cause__
+    assert isinstance(cause, WorkerError) and cause.rank == 0
+    assert "injected fatal failure" in cause.traceback_str
+    assert "worker log tail" in str(cause)  # rank-tagged log tail attached
