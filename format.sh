@@ -34,3 +34,9 @@ JAX_PLATFORMS=cpu python -m ray_lightning_tpu trace llama3-8b \
 # (rc=0) — proves kill -> classify -> relaunch -> resume end to end on a
 # box with no accelerator. docs/RESILIENCE.md "fault-injection cookbook".
 JAX_PLATFORMS=cpu python -m ray_lightning_tpu supervise --smoke > /dev/null
+
+# prefetch-overlap gate: a slow-loader CPU run must show pipeline
+# occupancy > 0 (the device prefetcher demonstrably kept batches
+# resident ahead of the step) — docs/PERFORMANCE.md. Exit 1 otherwise.
+JAX_PLATFORMS=cpu python -m ray_lightning_tpu perf --smoke --steps 25 \
+    > /dev/null

@@ -37,7 +37,8 @@ from ray_lightning_tpu.runtime import (
     launch_cpu_spmd,
 )
 from ray_lightning_tpu.utils import seed_everything, simulate_cpu_devices
-from ray_lightning_tpu import sweep
+from ray_lightning_tpu import pipeline, sweep
+from ray_lightning_tpu.pipeline import DevicePrefetcher
 from ray_lightning_tpu.resilience import (
     ResilienceConfig,
     RetryPolicy,
@@ -75,6 +76,8 @@ __all__ = [
     "seed_everything",
     "simulate_cpu_devices",
     "sweep",
+    "pipeline",
+    "DevicePrefetcher",
     "ResilienceConfig",
     "RetryPolicy",
     "SupervisedResult",

@@ -27,6 +27,15 @@ parsed, never executed:
     python -m ray_lightning_tpu lint ray_lightning_tpu/models
     python -m ray_lightning_tpu lint my_project.module --json
 
+``perf`` measures the hot-loop overlap machinery on THIS box (CPU-safe):
+device-prefetch speedup with a calibrated synthetic slow loader, plus
+the AOT warm-start compile metrics against the persistent compile
+cache. ``--smoke`` is the format.sh gate (pipeline occupancy must be
+> 0):
+
+    python -m ray_lightning_tpu perf --smoke
+    python -m ray_lightning_tpu perf --steps 80 --depth 4
+
 ``supervise`` runs a distributed fit under the resilience supervisor
 (resilience/supervisor.py, docs/RESILIENCE.md): transient failures
 restart the worker group and resume from the latest valid checkpoint.
@@ -337,6 +346,7 @@ def main(argv=None) -> int:
     from ray_lightning_tpu.analysis.cli import (
         add_lint_parser, add_trace_parser, run_lint, run_trace,
     )
+    from ray_lightning_tpu.pipeline.cli import add_perf_parser, run_perf
     from ray_lightning_tpu.resilience.cli import (
         add_supervise_parser, run_supervise,
     )
@@ -344,6 +354,7 @@ def main(argv=None) -> int:
     add_lint_parser(sub)
     add_trace_parser(sub)
     add_supervise_parser(sub)
+    add_perf_parser(sub)
     args = p.parse_args(argv)
     if args.cmd == "plan":
         return run_plan(args)
@@ -353,6 +364,8 @@ def main(argv=None) -> int:
         return run_trace(args)
     if args.cmd == "supervise":
         return run_supervise(args)
+    if args.cmd == "perf":
+        return run_perf(args)
     info = collect(probe=args.probe)
     if args.as_json:
         print(json.dumps(info))

@@ -95,6 +95,13 @@ RULES: Dict[str, Rule] = {r.id: r for r in (
          "the traced step's estimated peak HBM (params + opt state + "
          "activation high-water mark) exceeds the target chip's budget; "
          "the job will OOM at compile or at runtime"),
+    Rule("RLT304", "host-sync-in-hot-loop", "warning",
+         "a per-batch training loop synchronizes with the device every "
+         "step (float()/np.asarray()/.item()/block_until_ready on step "
+         "outputs outside the log cadence) or places batches with an "
+         "un-prefetched device_put on the critical path — each one "
+         "drains the device dispatch queue; fetch on a cadence and use "
+         "the device prefetch pipeline (docs/PERFORMANCE.md)"),
     Rule("RLT303", "ring-deadlock", "error",
          "a ppermute permutation is not a valid schedule (duplicate "
          "source/destination, out-of-range rank, a full permutation "

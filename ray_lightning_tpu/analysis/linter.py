@@ -447,6 +447,178 @@ def _lint_traced_body(lint: _FileLint, fn: _Func) -> None:
                          "sort it", node.iter, sym)
 
 
+# ---- RLT304: host sync in the per-batch hot loop --------------------------
+
+#: iterator names that mark a `for` loop as a per-batch training/eval
+#: loop (the RLT304 scope). Deliberately specific — `data` alone would
+#: flag every list walk in sight.
+_LOADER_NAME_TOKENS: Tuple[str, ...] = (
+    "loader", "dataloader", "batches", "dataiter",
+)
+
+#: calls flagged on step outputs inside the hot loop (outside cadence)
+_HOT_SYNC_CALLS: Set[str] = {
+    "float", "int",
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "jax.device_get", "device_get",
+    "jax.block_until_ready",
+}
+
+_HOT_SYNC_METHODS: Set[str] = {"item", "tolist", "block_until_ready"}
+
+
+def _loader_like(expr: ast.AST) -> bool:
+    """Does this `for` iterator look like a per-batch data source?"""
+    if isinstance(expr, ast.Call):
+        fname = _dotted(expr.func) or ""
+        last = fname.split(".")[-1].lower()
+        if last in ("enumerate", "iter", "zip", "islice"):
+            return any(_loader_like(a) for a in expr.args)
+        # loader factories: train_dataloader(), DataLoader(...)
+        return "dataloader" in last
+    name = _dotted(expr)
+    if name is None:
+        return False
+    last = name.split(".")[-1].lower()
+    return last == "dl" or any(t in last for t in _LOADER_NAME_TOKENS)
+
+
+def _under_cadence_guard(node: ast.AST, parents: Dict[int, ast.AST]) -> bool:
+    """True when `node` sits under an `if` whose test contains a `%`
+    (the `if step % N == 0:` log-cadence idiom) — a sync every N steps
+    is the sanctioned pattern, not the per-step bug."""
+    cur = parents.get(id(node))
+    while cur is not None:
+        if isinstance(cur, ast.If) and any(
+                isinstance(n, ast.BinOp) and isinstance(n.op, ast.Mod)
+                for n in ast.walk(cur.test)):
+            return True
+        cur = parents.get(id(cur))
+    return False
+
+
+def _root_name(expr: ast.AST) -> Optional[str]:
+    """The base Name of a value expression: metrics / metrics["loss"] /
+    out.loss → "metrics"/"out"."""
+    while isinstance(expr, (ast.Subscript, ast.Attribute, ast.Starred)):
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _walk_own_loop(stmts: List[ast.stmt]) -> Iterable[ast.AST]:
+    """Nodes of one hot loop's body, EXCLUDING nested function defs and
+    nested loader-like `for` loops — each nested hot loop is linted as
+    its own loop (walking into it here would report its findings twice:
+    once for the outer loop, once for its own pass)."""
+    stack: List[ast.AST] = list(stmts)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.For) and _loader_like(node.iter):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _loop_step_outputs(loop: ast.For) -> Set[str]:
+    """Names assigned inside the loop body from a call whose callee name
+    contains 'step' — the step outputs whose per-batch host fetch RLT304
+    flags. Tuple unpacking (`state, metrics = step(...)`) counts."""
+    outs: Set[str] = set()
+    for node in _walk_own_loop(loop.body):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not isinstance(node.value, ast.Call):
+            continue
+        callee = _dotted(node.value.func) or ""
+        if "step" not in callee.split(".")[-1].lower():
+            continue
+        for tgt in node.targets:
+            elts = tgt.elts if isinstance(tgt, ast.Tuple) else [tgt]
+            for el in elts:
+                if isinstance(el, ast.Name):
+                    outs.add(el.id)
+    return outs
+
+
+def _lint_hot_loop(lint: _FileLint, loop: ast.For,
+                   symbol: Optional[str]) -> None:
+    step_outputs = _loop_step_outputs(loop)
+    # parent links within the loop body, for the cadence-guard walkup
+    parents: Dict[int, ast.AST] = {}
+    for node in _walk_own_loop(loop.body):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+    for node in _walk_own_loop(loop.body):
+        if not isinstance(node, ast.Call):
+            continue
+        if _under_cadence_guard(node, parents):
+            continue
+        fname = _dotted(node.func)
+        if fname is not None and fname.split(".")[-1] == "device_put":
+            lint.add(
+                "RLT304",
+                "un-prefetched device_put in the per-batch loop: the "
+                "host->device placement sits on the critical path "
+                "every step — overlap it with compute "
+                "(pipeline.DevicePrefetcher / "
+                "Trainer(prefetch_to_device=N))", node, symbol)
+            continue
+        target: Optional[ast.AST] = None
+        what = None
+        if fname in _HOT_SYNC_CALLS and node.args:
+            target = node.args[0]
+            what = f"{fname}()"
+        elif (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _HOT_SYNC_METHODS
+                and not node.args and not node.keywords):
+            target = node.func.value
+            what = f".{node.func.attr}()"
+        if target is None:
+            continue
+        root = _root_name(target)
+        if root is not None and root in step_outputs:
+            lint.add(
+                "RLT304",
+                f"{what} on step output {root!r} inside the "
+                "per-batch loop forces a device sync every step — "
+                "the dispatch queue drains and the accelerator "
+                "idles; fetch on the log cadence "
+                "(if step % N == 0) or keep it on device", node,
+                symbol)
+
+
+class _HotLoopLint:
+    """RLT304 driver: finds per-batch loops in NON-traced code (traced
+    bodies are RLT201 territory) — both inside functions and at module
+    level — and lints each."""
+
+    def __init__(self, lint: _FileLint):
+        self.lint = lint
+
+    def run(self, tree: ast.Module, funcs: List["_Func"]) -> None:
+        for fn in funcs:
+            if fn.traced:
+                continue
+            for node in _own_nodes(fn.node):
+                if isinstance(node, ast.For) and _loader_like(node.iter):
+                    _lint_hot_loop(self.lint, node, fn.qualname)
+        # module-level training scripts (examples, quick experiments)
+        stack: List[ast.AST] = list(tree.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, ast.For) and _loader_like(node.iter):
+                _lint_hot_loop(self.lint, node, None)
+            stack.extend(ast.iter_child_nodes(node))
+
+
 #: APIs whose failures surface as WorkerError (or carry one): a trivial
 #: broad except around these is the anti-pattern RLT401 names. The
 #: distinctive names match anywhere; the GENERIC ones (`launch`,
@@ -697,6 +869,9 @@ def lint_source(source: str, filename: str = "<string>",
     for fn in coll.funcs:
         if fn.traced:
             _lint_traced_body(lint, fn)
+    # RLT304 needs the FINAL traced set: hot-loop rules fire only in
+    # non-traced code (a loop under a tracer is RLT201's scope)
+    _HotLoopLint(lint).run(tree, coll.funcs)
     return lint.findings
 
 

@@ -33,6 +33,9 @@ import hashlib
 import json
 import os
 import pickle
+import queue
+import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -47,13 +50,48 @@ _META_FILE = "meta.json"
 
 # meta.json writes deferred until their async state write finalizes —
 # meta.json presence is the "checkpoint is complete" marker, so it must
-# never exist over a still-streaming (or failed) state dir.
+# never exist over a still-streaming (or failed) state dir. Entries are
+# published by the background finalizer thread the moment their state
+# write commits (or by wait_for_checkpoints / the next blocking save,
+# whichever runs first); _META_LOCK guards the list.
 _PENDING_META: List[Tuple[str, Dict[str, Any]]] = []
+_META_LOCK = threading.Lock()
+
+#: paths whose meta/digest the finalizer thread is writing RIGHT NOW —
+#: deletion (checkpoint pruning) must not rmtree a dir mid-digest-walk.
+#: Guarded by _META_LOCK via the condition below.
+_FINALIZING: set = set()
+_FIN_CV = threading.Condition(_META_LOCK)
+
+#: async-write failures recorded by the finalizer thread; surfaced (and
+#: cleared) by the next wait_for_checkpoints()/save_checkpoint().
+_ASYNC_ERRORS: List[BaseException] = []
+
+#: finalizer thread: one daemon per process draining a queue of paths
+#: whose meta/digest should be published as soon as the orbax commit
+#: lands — a crash BETWEEN checkpoint cadences must not cost a fully
+#: written checkpoint its completeness marker.
+_FIN_QUEUE: "queue.Queue[str]" = queue.Queue()
+_FIN_THREAD: Optional[threading.Thread] = None
+
+#: overlap accounting (save stalls are the number the async path exists
+#: to shrink); read via io_stats(), surfaced in callback_metrics.
+_STATS = {"async_saves": 0, "blocking_saves": 0,
+          "stall_s": 0.0, "last_stall_s": 0.0}
 
 # Singleton: StandardCheckpointer is an AsyncCheckpointer — in-flight
 # background writes must not be garbage-collected with a per-call
 # instance, and wait_for_checkpoints() needs a handle to join them.
 _CKPT: Optional[ocp.StandardCheckpointer] = None
+
+#: serializes every save()/wait_until_finished() on the checkpointer:
+#: orbax's wait does `thread.join(); self._thread = None`, so a
+#: finalizer-thread wait racing a new main-thread save could null out
+#: the NEW commit thread's handle — a later wait would then return
+#: early and meta could be published over a still-streaming write.
+#: Holding the lock through a wait costs nothing extra: a concurrent
+#: save would have waited for the in-flight write inside orbax anyway.
+_CK_LOCK = threading.RLock()
 
 
 def _checkpointer() -> ocp.StandardCheckpointer:
@@ -61,6 +99,56 @@ def _checkpointer() -> ocp.StandardCheckpointer:
     if _CKPT is None:
         _CKPT = ocp.StandardCheckpointer()
     return _CKPT
+
+
+def io_stats() -> Dict[str, float]:
+    """Checkpoint-overlap counters: cumulative seconds the TRAINING
+    thread spent blocked waiting for earlier checkpoint writes
+    (``ckpt_stall_s``) and the save counts. The async path's win is this
+    number staying ~0 while checkpoints still land."""
+    return {
+        "ckpt_async_saves": float(_STATS["async_saves"]),
+        "ckpt_blocking_saves": float(_STATS["blocking_saves"]),
+        "ckpt_stall_s": _STATS["stall_s"],
+        "ckpt_last_stall_s": _STATS["last_stall_s"],
+    }
+
+
+def device_snapshot(tree: Any) -> Any:
+    """Fresh runtime-owned device buffers for `tree` via the no-donation
+    jitted identity: the output CANNOT alias the input, so the snapshot
+    survives the trainer donating the live state into the next step
+    while the background write streams from it. (The same mechanism
+    `restore_checkpoint` uses in the other direction — donating
+    TensorStore-owned buffers corrupted resumed weights.)"""
+    return jax.jit(lambda t: t)(tree)
+
+
+def _timed_drain(ck) -> None:
+    """Join any in-flight write on the calling (training) thread and
+    account the wait as checkpoint stall."""
+    t0 = time.perf_counter()
+    try:
+        with _CK_LOCK:
+            ck.wait_until_finished()
+    except Exception as exc:  # noqa: BLE001 — recorded, surfaced below
+        with _META_LOCK:
+            _ASYNC_ERRORS.append(exc)
+    stall = time.perf_counter() - t0
+    _STATS["stall_s"] += stall
+    _STATS["last_stall_s"] = stall
+
+
+def _raise_recorded_errors() -> None:
+    """Surface (once) any failure the background machinery recorded; a
+    failed write conservatively drops ALL deferred metas — an
+    un-finalized dir reads as no checkpoint."""
+    with _META_LOCK:
+        if not _ASYNC_ERRORS:
+            return
+        errors, _ASYNC_ERRORS[:] = list(_ASYNC_ERRORS), []
+        _PENDING_META.clear()
+    raise errors[0]
 
 
 def save_checkpoint(
@@ -74,10 +162,16 @@ def save_checkpoint(
     Multi-host safe: every process must call this collectively; orbax
     writes each host's addressable shards.
 
-    ``block=False`` returns as soon as the device->host copy is done and
-    streams the disk write in the background (training continues during
-    I/O — the big-model checkpoint stall killer); join with
-    `wait_for_checkpoints()` before reading the files or exiting.
+    ``block=False`` is a real background commit: the state is snapshotted
+    on device via the no-donation identity (so the trainer may donate the
+    live state into the very next step), the serialize streams in the
+    background, and a finalizer thread publishes meta.json + content
+    digest the moment the state write commits — atomically, so a crash at
+    any point leaves either a complete, verifiable checkpoint or a torn
+    dir that `latest_checkpoint` skips. Join with `wait_for_checkpoints()`
+    before reading the files or exiting; time spent here waiting for a
+    previous in-flight write is accounted as ``ckpt_stall_s``
+    (`io_stats`).
     """
     path = os.path.abspath(path)
     os.makedirs(path, exist_ok=True)
@@ -86,17 +180,32 @@ def save_checkpoint(
     if hparams is not None:
         meta["hparams_pickle_hex"] = pickle.dumps(hparams).hex()
     ck = _checkpointer()
-    ck.save(os.path.join(path, _STATE_DIR), state, force=True)
+    # drain any previous in-flight write OURSELVES (orbax would anyway,
+    # inside save) so the wait is measured as checkpoint stall — the
+    # number the async pipeline exists to shrink — and so a recorded
+    # background failure surfaces here rather than half-way into orbax.
+    _timed_drain(ck)
+    _raise_recorded_errors()
+    if not block:
+        state = device_snapshot(state)
+    with _CK_LOCK:
+        ck.save(os.path.join(path, _STATE_DIR), state, force=True)
     if block:
-        ck.wait_until_finished()
+        _STATS["blocking_saves"] += 1
+        with _CK_LOCK:
+            ck.wait_until_finished()
         # the join above finalized EVERY in-flight write, including earlier
         # async ones — flush their deferred metas too, then write ours
         _flush_pending_meta()
         _write_meta(path, meta)
     else:
-        # meta.json is the completeness marker — defer it until
-        # wait_for_checkpoints() confirms the state write finalized.
-        _PENDING_META.append((path, meta))
+        _STATS["async_saves"] += 1
+        # meta.json is the completeness marker — deferred until the state
+        # write finalizes; the finalizer thread publishes it eagerly.
+        with _META_LOCK:
+            _PENDING_META.append((path, meta))
+        _ensure_finalizer()
+        _FIN_QUEUE.put(path)
     return path
 
 
@@ -227,39 +336,122 @@ def latest_checkpoint(directory: str) -> Optional[str]:
     return None
 
 
+def _ensure_finalizer() -> None:
+    """Start the per-process finalizer thread (idempotent)."""
+    global _FIN_THREAD
+    if _FIN_THREAD is not None and _FIN_THREAD.is_alive():
+        return
+    _FIN_THREAD = threading.Thread(
+        target=_finalizer_loop, name="rlt-ckpt-finalize", daemon=True)
+    _FIN_THREAD.start()
+
+
+def _finalizer_loop() -> None:
+    """Publish each async save's meta/digest as soon as its state write
+    commits. Entries are processed one at a time: when we dequeue a path
+    its orbax save has already STARTED (save_checkpoint enqueues after
+    ck.save returned), so wait_until_finished() returning means THAT
+    write committed — publishing only this entry's meta can never mark a
+    later, still-streaming checkpoint complete."""
+    while True:
+        path = _FIN_QUEUE.get()
+        try:
+            try:
+                with _CK_LOCK:
+                    _checkpointer().wait_until_finished()
+            except Exception as exc:  # noqa: BLE001 — surfaced on next join
+                with _META_LOCK:
+                    _ASYNC_ERRORS.append(exc)
+                    # the torn write must never gain a completeness marker
+                    _discard_locked(path)
+                continue
+            # take-and-mark atomically: once marked, a concurrent
+            # discard_pending_meta (checkpoint pruning about to rmtree
+            # this dir) BLOCKS until the meta/digest write is off the
+            # directory; once discarded, we skip the write entirely.
+            with _FIN_CV:
+                meta = _take_pending_locked(path)
+                if meta is not None:
+                    _FINALIZING.add(path)
+            if meta is not None:
+                try:
+                    _write_meta(path, meta)
+                finally:
+                    with _FIN_CV:
+                        _FINALIZING.discard(path)
+                        _FIN_CV.notify_all()
+        except Exception as exc:  # noqa: BLE001 — a meta/digest failure
+            # is an async error like any other; never kill the thread
+            with _META_LOCK:
+                _ASYNC_ERRORS.append(exc)
+        finally:
+            _FIN_QUEUE.task_done()
+
+
+def _take_pending_locked(path: str) -> Optional[Dict[str, Any]]:
+    for i, (pp, meta) in enumerate(_PENDING_META):
+        if pp == path:
+            del _PENDING_META[i]
+            return meta
+    return None
+
+
 def _flush_pending_meta() -> None:
-    global _PENDING_META
-    pending, _PENDING_META = _PENDING_META, []
-    for path, meta in pending:
+    while True:
+        with _META_LOCK:
+            if not _PENDING_META:
+                return
+            path, meta = _PENDING_META.pop(0)
         _write_meta(path, meta)
+
+
+def _discard_locked(path: str) -> bool:
+    p = os.path.abspath(path)
+    had = any(pp == p for pp, _ in _PENDING_META)
+    if had:
+        _PENDING_META[:] = [(pp, m) for pp, m in _PENDING_META if pp != p]
+    return had
 
 
 def discard_pending_meta(path: str) -> bool:
     """Forget the deferred meta for `path` (its checkpoint dir is being
     deleted). Returns True if an entry existed — i.e. the state write may
     still be streaming into that dir, so callers should join in-flight
-    writes before removing it."""
-    global _PENDING_META
+    writes before removing it. If the finalizer thread is writing this
+    path's meta/digest RIGHT NOW, blocks (bounded) until its hands are
+    off the directory — an rmtree racing the digest walk would otherwise
+    corrupt neither-here-nor-there state."""
     p = os.path.abspath(path)
-    had = any(pp == p for pp, _ in _PENDING_META)
-    if had:
-        _PENDING_META = [(pp, m) for pp, m in _PENDING_META if pp != p]
-    return had
+    with _FIN_CV:
+        had = _discard_locked(p)
+        deadline = time.monotonic() + 60.0
+        while p in _FINALIZING:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                log.warning("finalizer still writing %s after 60s; "
+                            "proceeding with deletion", p)
+                break
+            _FIN_CV.wait(timeout=min(remaining, 1.0))
+        return had
 
 
 def wait_for_checkpoints() -> None:
-    """Join all in-flight async checkpoint writes (no-op when none), then
-    finalize their meta.json markers. If any write failed, NO deferred meta
+    """Join all in-flight async checkpoint writes (no-op when none) and
+    their meta.json finalizations. If any write failed, NO deferred meta
     is written (conservative: an un-finalized dir reads as no checkpoint)
-    and the error propagates to the caller."""
-    global _PENDING_META
+    and the first recorded error propagates to the caller."""
+    if _FIN_THREAD is not None and _FIN_THREAD.is_alive():
+        _FIN_QUEUE.join()
     try:
         if _CKPT is not None:
-            _CKPT.wait_until_finished()
+            with _CK_LOCK:
+                _CKPT.wait_until_finished()
     except Exception:
-        _PENDING_META = []
+        with _META_LOCK:
+            _PENDING_META.clear()
         raise
     _flush_pending_meta()
+    _raise_recorded_errors()
 
 
 def load_checkpoint(path: str) -> Dict[str, Any]:
@@ -292,10 +484,9 @@ def restore_checkpoint(path: str, target: Any) -> Any:
     # checkpoint runtime still references lets XLA reuse memory it does
     # not own — observed on the CPU backend as intermittent SIGSEGV /
     # SIGABRT mid-run and, worse, silently corrupted params after a
-    # resume (flaky denormal garbage in the resumed weights). A jitted
-    # identity without donation cannot alias its inputs, so it
-    # materializes fresh runtime-owned buffers with the same shardings.
-    return jax.jit(lambda t: t)(restored)
+    # resume (flaky denormal garbage in the resumed weights). The same
+    # no-donation identity protects the save direction (device_snapshot).
+    return device_snapshot(restored)
 
 
 def read_meta(path: str) -> Dict[str, Any]:

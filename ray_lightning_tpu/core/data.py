@@ -142,6 +142,42 @@ class DataLoader:
         return self._batcher
 
 
+class ThrottledLoader:
+    """Wrap a loader with a fixed per-batch host delay.
+
+    The deliberately-slow synthetic loader behind the prefetch-overlap
+    evidence (pipeline/overlap.py, bench.py, ``python -m
+    ray_lightning_tpu perf``): real input pipelines pay tokenization /
+    decode / augmentation time per batch, which a CPU benchmark box
+    doesn't naturally have — ``delay_s`` stands in for it, so the
+    device-prefetch win is measurable anywhere. Also a testing hook: a
+    known per-batch cost makes backpressure and overlap assertions
+    deterministic.
+
+    Forwards ``set_epoch``/``__len__`` so it drops into every place a
+    `DataLoader` does.
+    """
+
+    def __init__(self, inner: Any, delay_s: float):
+        self.inner = inner
+        self.delay_s = float(delay_s)
+
+    def set_epoch(self, epoch: int) -> None:
+        if hasattr(self.inner, "set_epoch"):
+            self.inner.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        return len(self.inner)
+
+    def __iter__(self) -> Iterator[Any]:
+        import time
+
+        for batch in self.inner:
+            if self.delay_s > 0:
+                time.sleep(self.delay_s)
+            yield batch
+
+
 class DataModule:
     """Optional Lightning-style data container."""
 

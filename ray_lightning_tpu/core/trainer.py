@@ -47,6 +47,14 @@ from ray_lightning_tpu.core.data import DataModule
 from ray_lightning_tpu.core.module import TpuModule
 from ray_lightning_tpu.core.state import TrainState
 from ray_lightning_tpu.parallel.strategy import SingleDevice, Strategy
+from ray_lightning_tpu.pipeline.compile_cache import (
+    WarmStep,
+    enable_persistent_cache,
+)
+from ray_lightning_tpu.pipeline.prefetch import (
+    DevicePrefetcher,
+    prefetch_to_device,
+)
 from ray_lightning_tpu.utils import get_logger, seed_everything
 
 log = get_logger(__name__)
@@ -74,6 +82,9 @@ class Trainer:
         enable_progress_bar: bool = True,
         profiler_dir: Optional[str] = None,
         num_sanity_val_steps: int = 0,
+        prefetch_to_device: int = 2,
+        warm_start: bool = True,
+        compile_cache_dir: Optional[str] = None,
     ):
         self.strategy = strategy or SingleDevice()
         self.max_epochs = max_epochs
@@ -95,6 +106,19 @@ class Trainer:
         )
         self.profiler_dir = profiler_dir
         self.num_sanity_val_steps = num_sanity_val_steps
+        #: device-prefetch buffer depth (pipeline/prefetch.py): a
+        #: background stage overlaps host batch assembly + sharded
+        #: device_put with the previous step's compute. 0 disables
+        #: (fully synchronous placement, bitwise-identical training).
+        self.prefetch_to_device = max(0, prefetch_to_device)
+        #: AOT-compile the train step at fit start (lower().compile(),
+        #: pipeline/compile_cache.py) so compile time is a reported
+        #: metric, not a mysteriously slow first batch; the eval step
+        #: warms on its first batch. Shape drift falls back to lazy jit.
+        self.warm_start = warm_start
+        #: persistent XLA compilation cache dir; restarts (resilience
+        #: supervisor) then deserialize the step instead of recompiling.
+        self.compile_cache_dir = compile_cache_dir
 
         self.callbacks: List[Callback] = list(callbacks or [])
         if enable_checkpointing and not any(
@@ -162,10 +186,17 @@ class Trainer:
         self.has_validation = val_dataloaders is not None
         example_batch, train_dataloaders = self._peek(train_dataloaders)
 
+        if self.compile_cache_dir:
+            # persistent cache BEFORE any step compiles: a restarted
+            # worker (resilience supervisor) then deserializes every
+            # program instead of recompiling it
+            enable_persistent_cache(self.compile_cache_dir)
         self.tx = self._build_tx(module)
         self.state = self._init_state(module, example_batch, ckpt_path)
         self._train_step = self._make_train_step(module)
         self._eval_step = self._make_eval_step(module, module.validation_step)
+        if self.warm_start:
+            self._warm_start_train_step(example_batch)
 
         module.on_fit_start(self)
         self._invoke("on_fit_start")
@@ -245,47 +276,62 @@ class Trainer:
             if next(it, None) is None:
                 break
         completed = False
-        # start=skip: callbacks must see the true intra-epoch batch index
-        # after a mid-epoch resume
-        for batch_idx, batch in enumerate(it, start=skip):
-            if (
-                self.limit_train_batches is not None
-                # count from epoch start, not resume point, so a resumed
-                # epoch sees limit - already_consumed more batches
-                and self._epoch_batches_done >= self.limit_train_batches
-            ):
-                # the limit DEFINES the epoch length (PTL semantics), so
-                # hitting it is epoch completion, not a mid-epoch cut
+        # Device prefetch (pipeline/prefetch.py): cast + sharded placement
+        # run up to `depth` batches ahead on a producer thread, so the
+        # step's input is resident when it dispatches. The skip above
+        # already advanced the raw iterator, so a mid-epoch resume never
+        # pays placement for batches it will drop. Order is preserved —
+        # training is bitwise-identical to the synchronous path.
+        stream = prefetch_to_device(
+            it, self._place_train_batch, depth=self.prefetch_to_device)
+        try:
+            # start=skip: callbacks must see the true intra-epoch batch
+            # index after a mid-epoch resume
+            for batch_idx, (bs, device_batch) in enumerate(stream,
+                                                           start=skip):
+                if (
+                    self.limit_train_batches is not None
+                    # count from epoch start, not resume point, so a
+                    # resumed epoch sees limit - already_consumed more
+                    and self._epoch_batches_done >= self.limit_train_batches
+                ):
+                    # the limit DEFINES the epoch length (PTL semantics),
+                    # so hitting it is epoch completion, not a mid-epoch cut
+                    completed = True
+                    break
+                self.last_batch_size = bs
+                self.state, metrics = self._train_step(
+                    self.state, device_batch, self._base_rng
+                )
+                self.global_step += 1
+                self._epoch_batches_done += 1
+                pending = metrics
+                # Lazy metric fetch: only sync on the logging cadence.
+                if self.global_step % max(1, self.log_every_n_steps) == 0:
+                    host = _to_host(metrics)
+                    self.callback_metrics.update(host)
+                    pending = host
+                self._invoke("on_train_batch_end", pending, batch_idx)
+                if (self.val_check_interval and self.has_validation
+                        and val_loader is not None
+                        and self.global_step % self.val_check_interval == 0):
+                    metrics = self._run_eval_epoch(
+                        val_loader, limit=self.limit_val_batches)
+                    self._last_val_step = self.global_step
+                    self.callback_metrics.update(metrics)
+                    self.module.on_validation_epoch_end(self, metrics)
+                    self._invoke("on_validation_epoch_end", metrics)
+                if self.should_stop or self._hit_max_steps():
+                    break
+            else:
                 completed = True
-                break
-            batch = self._cast(batch)
-            self.last_batch_size = _leading_dim(batch)
-            device_batch = self._shard_train_batch(batch)
-            self.state, metrics = self._train_step(
-                self.state, device_batch, self._base_rng
-            )
-            self.global_step += 1
-            self._epoch_batches_done += 1
-            pending = metrics
-            # Lazy metric fetch: only sync on the logging cadence.
-            if self.global_step % max(1, self.log_every_n_steps) == 0:
-                host = _to_host(metrics)
-                self.callback_metrics.update(host)
-                pending = host
-            self._invoke("on_train_batch_end", pending, batch_idx)
-            if (self.val_check_interval and self.has_validation
-                    and val_loader is not None
-                    and self.global_step % self.val_check_interval == 0):
-                metrics = self._run_eval_epoch(
-                    val_loader, limit=self.limit_val_batches)
-                self._last_val_step = self.global_step
-                self.callback_metrics.update(metrics)
-                self.module.on_validation_epoch_end(self, metrics)
-                self._invoke("on_validation_epoch_end", metrics)
-            if self.should_stop or self._hit_max_steps():
-                break
-        else:
-            completed = True
+        finally:
+            # a mid-epoch exit of ANY kind (max_steps, early stop, a
+            # preemption drain raising out of a callback) must join the
+            # producer thread — never leak it holding the loader
+            if isinstance(stream, DevicePrefetcher):
+                stream.close()
+                self.callback_metrics.update(stream.stats.to_metrics())
         if completed:
             # every batch of this epoch was consumed — subsequent saves
             # (epoch-boundary validation / on_train_epoch_end) resume at
@@ -306,19 +352,26 @@ class Trainer:
             loader.set_epoch(self.current_epoch)
         totals: Dict[str, Any] = {}
         weights = 0.0
-        for batch_idx, batch in enumerate(loader):
-            if limit is not None and batch_idx >= limit:
-                break
-            batch = self._cast(batch)
-            bs = _leading_dim(batch) or 1
-            device_batch = self.strategy.shard_batch(batch)
-            metrics = self._eval_step(self.state.params, device_batch)
-            for k, v in metrics.items():
-                # accumulate in f32 — a bf16 step metric summed over
-                # hundreds of batches would round away the increments
-                scaled = jnp.asarray(v).astype(jnp.float32) * bs
-                totals[k] = totals[k] + scaled if k in totals else scaled
-            weights += bs
+        stream = prefetch_to_device(
+            loader, self._place_eval_batch, depth=self.prefetch_to_device)
+        try:
+            for batch_idx, (bs, device_batch) in enumerate(stream):
+                if limit is not None and batch_idx >= limit:
+                    break
+                metrics = self._eval_step(self.state.params, device_batch)
+                for k, v in metrics.items():
+                    # accumulate in f32 — a bf16 step metric summed over
+                    # hundreds of batches would round away the increments
+                    scaled = jnp.asarray(v).astype(jnp.float32) * bs
+                    totals[k] = totals[k] + scaled if k in totals else scaled
+                weights += bs
+        finally:
+            if isinstance(stream, DevicePrefetcher):
+                stream.close()
+        if (isinstance(self._eval_step, WarmStep)
+                and self._eval_step.stats.total_s):
+            self.callback_metrics.update(
+                self._eval_step.stats.to_metrics("val_"))
         if sanity or weights == 0:
             return {}
         host = _to_host(totals)
@@ -387,7 +440,13 @@ class Trainer:
         }
         self.module.on_save_checkpoint(checkpoint)
         self._invoke("on_save_checkpoint", checkpoint)
-        return save_checkpoint(path, checkpoint, ckpt_meta, block=block)
+        out = save_checkpoint(path, checkpoint, ckpt_meta, block=block)
+        # checkpoint-overlap accounting: how long the TRAINING thread
+        # stalled on checkpoint I/O (the async path's win is ~0 here)
+        from ray_lightning_tpu.checkpoint.io import io_stats
+
+        self.callback_metrics.update(io_stats())
+        return out
 
     # ------------------------------------------------------------ plumbing
 
@@ -544,7 +603,11 @@ class Trainer:
                 metrics,
             )
 
-        return jax.jit(step, donate_argnums=(0,))
+        # check_args=(1,): only the batch can drift — re-checking the
+        # whole TrainState per step would put O(param leaves) host work
+        # back on the hot path
+        return WarmStep(jax.jit(step, donate_argnums=(0,)),
+                        label="train_step", check_args=(1,))
 
     def _make_eval_step(self, module: TpuModule, step_fn):
         def step(params, batch):
@@ -556,7 +619,33 @@ class Trainer:
                 metrics = {"val_loss": metrics}
             return {**metrics, **logged}
 
-        return jax.jit(step)
+        # auto: the eval batch shape is unknown until validation runs, so
+        # the AOT compile happens on the first eval batch (still recorded
+        # as a first-class metric, val_compile_time_s)
+        return WarmStep(jax.jit(step), label="eval_step",
+                        auto=self.warm_start, check_args=(1,))
+
+    def _warm_start_train_step(self, example_batch) -> None:
+        """AOT lower().compile() the train step for the known shapes —
+        the cold compile happens HERE, visible as compile_time_s, instead
+        of hiding inside the first batch. With a persistent cache
+        (compile_cache_dir / the supervisor's per-plan dir) a restarted
+        process deserializes instead of recompiling, so this reads ~zero
+        on every warm start after the first."""
+        _, device_batch = self._place_train_batch(example_batch)
+        stats = self._train_step.warm(self.state, device_batch,
+                                      self._base_rng)
+        self.callback_metrics.update(stats.to_metrics())
+
+    def _place_train_batch(self, batch):
+        """Host batch -> (leading dim, device-resident batch); the
+        prefetcher's producer stage (runs on its thread)."""
+        batch = self._cast(batch)
+        return _leading_dim(batch), self._shard_train_batch(batch)
+
+    def _place_eval_batch(self, batch):
+        batch = self._cast(batch)
+        return _leading_dim(batch) or 1, self.strategy.shard_batch(batch)
 
     def _shard_train_batch(self, batch):
         accum = self.accumulate_grad_batches

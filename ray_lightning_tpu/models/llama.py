@@ -148,6 +148,27 @@ class LlamaConfig:
             hidden_dim=128, max_seq_len=256, remat=False), **kw})
 
 
+def _is_flash_remat_opt(params) -> bool:
+    """Is this `remat_opt` equation the flash kernel's hoisted fwd rule?
+
+    `optimize_remat=True` rewrites EVERY such custom_vjp into a
+    `remat_opt` call, so a policy keyed on the primitive name alone
+    would save the residuals of any future optimized-remat custom_vjp
+    in the model, not specifically attention's. The flash fwd rule tags
+    its residual tuple with checkpoint_name("flash_residuals")
+    (ops/pallas/flash.py _flash_fwd_rule) — those `name` equations are
+    visible in the hoisted fwd jaxpr carried in the eqn params, which is
+    the precise fingerprint."""
+    fwd = params.get("fwd_jaxpr")
+    jaxpr = getattr(fwd, "jaxpr", None)
+    if jaxpr is None:
+        return False
+    return any(
+        eqn.primitive.name == "name"
+        and eqn.params.get("name") == "flash_residuals"
+        for eqn in jaxpr.eqns)
+
+
 def _attn_residuals_saveable(prim, *avals, **params) -> bool:
     """Checkpoint policy for remat_policy="attn_out": save the flash
     kernel's VJP residuals (q/k/v/o/lse) plus the block-level attention
@@ -158,12 +179,15 @@ def _attn_residuals_saveable(prim, *avals, **params) -> bool:
     call whose outputs ARE the residual tuple — a custom_vjp is
     otherwise opaque to checkpoint policies (its residuals never appear
     in the primal trace; a named-saveable policy alone verifiably saved
-    nothing, tests/test_ops.py). Saving remat_opt outputs is therefore
+    nothing, tests/test_ops.py). Saving the FLASH kernel's remat_opt
+    outputs (scoped via `_is_flash_remat_opt` — any other
+    optimize_remat custom_vjp keeps its own remat policy) is therefore
     exactly "save the attention residuals". The `name` check covers the
     XLA-reference attention path, whose output is tagged "attn_out" in
-    LlamaBlock."""
+    LlamaBlock; the pallas branch deliberately does NOT tag (the kernel
+    residuals already include o — tagging would double-save it)."""
     if prim.name == "remat_opt":
-        return True
+        return _is_flash_remat_opt(params)
     return prim.name == "name" and params.get("name") == "attn_out"
 
 
@@ -212,6 +236,7 @@ class LlamaBlock(nn.Module):
         if cache is None:
             q = apply_rope(q, cos, sin)
             k = apply_rope(k, cos, sin)
+            pallas_path = False
             if (cfg.seq_parallel and self.mesh is not None
                     and self.mesh.shape.get("seq", 1) > 1):
                 # manual island: sequence sharded over `seq`; everything
@@ -225,17 +250,27 @@ class LlamaBlock(nn.Module):
             else:
                 # use_flash=True -> auto (pallas on TPU, XLA fallback
                 # elsewhere); False -> always the XLA reference path.
+                from ray_lightning_tpu.ops.attention import flash_uses_pallas
+
+                pallas_path = flash_uses_pallas(
+                    q.shape, k.shape, None if cfg.use_flash else False)
                 attn = flash_attention(
                     q, k, v, causal=True,
                     use_pallas=None if cfg.use_flash else False)
             # name the attention output for remat_policy="attn_out" —
-            # this is the save point the XLA-reference attention path
-            # offers (the pallas path additionally names its full VJP
-            # residual set inside the kernel's fwd rule); under other
-            # policies the name is inert
-            from jax.ad_checkpoint import checkpoint_name
+            # the save point the XLA-reference (and seq-parallel island)
+            # paths offer. The pallas branch is deliberately NOT named:
+            # its full VJP residual set (incl. o) is already saved
+            # through the kernel's own remat_opt hoist, and naming the
+            # output again would keep a second [B, S, H·hd] residual per
+            # layer beyond what parallel/plan.py accounts. Under other
+            # policies the name is inert. flash_uses_pallas is the SAME
+            # predicate the dispatch uses, so the annotation cannot
+            # drift from the path actually taken.
+            if not pallas_path:
+                from jax.ad_checkpoint import checkpoint_name
 
-            attn = checkpoint_name(attn, "attn_out")
+                attn = checkpoint_name(attn, "attn_out")
             new_cache = None
         else:
             positions = pos + jnp.arange(S)

@@ -70,6 +70,24 @@ def dot_product_attention(
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def flash_uses_pallas(q_shape, k_shape, use_pallas: bool | None = None,
+                      masked: bool = False) -> bool:
+    """Would `flash_attention` take the pallas kernel for these shapes
+    and arguments? ONE predicate shared with the dispatch itself so
+    callers that must know the outcome (the block-level remat annotation
+    in models/llama.py: the pallas path's residuals are saved through the
+    kernel's own `remat_opt` hoist, and naming its output again would
+    double-save a [B, S, H·hd] tensor per layer) can never drift from
+    what actually runs."""
+    from ray_lightning_tpu.ops import dispatch
+
+    if masked or not dispatch.use_pallas(use_pallas):
+        return False
+    from ray_lightning_tpu.ops.pallas.flash import shapes_supported
+
+    return shapes_supported(q_shape, k_shape)
+
+
 def flash_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -83,17 +101,11 @@ def flash_attention(
     (or forced via RLT_PALLAS=1 with interpret mode on CPU) and the shape
     tiles cleanly; otherwise the XLA reference path (which XLA still fuses
     reasonably — flash matters at long S where the S×S scores don't fit)."""
-    from ray_lightning_tpu.ops import dispatch
+    if flash_uses_pallas(q.shape, k.shape, use_pallas,
+                         masked=mask is not None):
+        from ray_lightning_tpu.ops.pallas.flash import flash_attention_pallas
 
-    use_pallas = dispatch.use_pallas(use_pallas)
-    if use_pallas and mask is None:
-        from ray_lightning_tpu.ops.pallas.flash import (
-            flash_attention_pallas,
-            shapes_supported,
-        )
-
-        if shapes_supported(q.shape, k.shape):
-            return flash_attention_pallas(q, k, v, causal=causal,
-                                          q_offset=q_offset)
+        return flash_attention_pallas(q, k, v, causal=causal,
+                                      q_offset=q_offset)
     return dot_product_attention(q, k, v, causal=causal, mask=mask,
                                  q_offset=q_offset)

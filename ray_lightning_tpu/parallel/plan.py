@@ -264,12 +264,18 @@ def llama_activation_bytes(cfg, local_batch: int, seq: int,
     bs = local_batch * seq
     hd = cfg.head_dim
     saved = cfg.n_layers * bs * cfg.dim * 2
-    if getattr(cfg, "remat_policy", "nothing") == "attn_out":
+    if (getattr(cfg, "remat", True)
+            and getattr(cfg, "remat_policy", "nothing") == "attn_out"):
         # per-layer saved attention residuals (q, o: H·hd; k, v: Hkv·hd;
-        # model dtype) + the f32 logsumexp — models/llama.py
-        # _attn_residuals_saveable
+        # model dtype — charged at cfg.dtype's width, not a bf16
+        # assumption) + the f32 logsumexp — models/llama.py
+        # _attn_residuals_saveable. Gated on cfg.remat: with remat=False
+        # the model documents the policy as ignored, so charging the
+        # residuals would overestimate against the config contract.
+        elem = int(np.dtype(cfg.dtype).itemsize) if getattr(
+            cfg, "dtype", None) is not None else 2
         saved += cfg.n_layers * bs * (
-            (2 * cfg.n_heads + 2 * cfg.n_kv_heads) * hd * 2
+            (2 * cfg.n_heads + 2 * cfg.n_kv_heads) * hd * elem
             + cfg.n_heads * 4)
     live = bs * (
         2 * cfg.dim

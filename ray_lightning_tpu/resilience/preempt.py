@@ -113,6 +113,19 @@ class PreemptionGuard(Callback):
         path = os.path.join(
             self.dirpath, f"preempt-step={trainer.global_step}")
         ckpt: Optional[str] = None
+        # Drain in-flight ASYNC saves first: their checkpoints may be the
+        # resume fallback if the emergency save below doesn't finish
+        # inside the grace window, so they must be finalized (meta +
+        # digest published) — and a failed one must be invalidated, not
+        # allowed to fail the emergency save itself.
+        try:
+            from ray_lightning_tpu.checkpoint import wait_for_checkpoints
+
+            wait_for_checkpoints()
+        except Exception:  # noqa: BLE001 — the torn write stays
+            # unfinalized (invalid, skipped on resume); keep draining
+            log.exception("in-flight async checkpoint failed during "
+                          "preemption drain; it will be skipped on resume")
         try:
             # block=True: an async write could still be streaming when
             # the platform pulls the plug — durability beats latency here

@@ -66,6 +66,21 @@ class ResilienceConfig:
     faults: Optional[str] = None    # fault-plan spec (faults.parse_faults)
     fault_state_dir: Optional[str] = None  # fire-once markers across
     #                                 restarts (defaults beside ckpts)
+    #: step-cadence checkpoints stream in the background instead of
+    #: stalling every rank at each save (checkpoint/io.py block=False;
+    #: docs/PERFORMANCE.md). Emergency preemption saves always block.
+    async_save: bool = True
+    #: persistent XLA compile cache shared across restarts, so attempt N
+    #: deserializes the train step instead of recompiling it (the cold
+    #: compile otherwise multiplies by the restart budget). None derives
+    #: ``<checkpoint_dir>/.compile_cache``; "off" disables.
+    compile_cache_dir: Optional[str] = None
+
+    def resolved_compile_cache_dir(self) -> Optional[str]:
+        if self.compile_cache_dir == "off":
+            return None
+        return self.compile_cache_dir or os.path.join(
+            self.checkpoint_dir, ".compile_cache")
 
 
 @dataclasses.dataclass
@@ -128,15 +143,30 @@ def _wrapped_trainer_factory(trainer_factory: Callable[[], Any],
 
     trainer = trainer_factory()
     reset_preemption()  # fresh process; stale flags impossible but cheap
+    cache_dir = cfg.resolved_compile_cache_dir()
+    if cache_dir and not trainer.compile_cache_dir:
+        # restart N must deserialize the step, not recompile it — the
+        # trainer reports the (near-zero) warm compile as compile_time_s
+        trainer.compile_cache_dir = cache_dir
     has_periodic = any(
         isinstance(c, ModelCheckpoint)
         and getattr(c, "dirpath", None) == cfg.checkpoint_dir
         for c in trainer.callbacks)
+    # Async step-cadence saves only when this job is single-process: the
+    # in-tree orbax finalizes multi-host writes with a sync_global_devices
+    # barrier (an XLA psum) on its background commit thread, which could
+    # interleave with the step's own collectives mid-epoch. Multi-process
+    # jobs keep the blocking save until the barrier rides the
+    # coordination service (docs/PERFORMANCE.md "async checkpointing").
+    import jax
+
+    async_ok = cfg.async_save and jax.process_count() == 1
     if not has_periodic:
         trainer.callbacks.append(ModelCheckpoint(
             dirpath=cfg.checkpoint_dir, monitor=None,
             every_n_train_steps=max(1, cfg.save_every_n_steps),
-            save_top_k=max(2, cfg.keep_checkpoints)))
+            save_top_k=max(2, cfg.keep_checkpoints),
+            async_save=async_ok))
     if cfg.heartbeat_interval_s > 0:
         trainer.callbacks.append(
             HeartbeatCallback(cfg.heartbeat_interval_s))

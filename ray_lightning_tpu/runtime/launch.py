@@ -125,8 +125,12 @@ def _spmd_main(
                 f"{flags} --xla_force_host_platform_device_count="
                 f"{num_cpu_devices}")
         # Cross-process CPU collectives ride gloo (the CI fabric; on TPU
-        # the fabric is ICI and this knob is untouched).
-        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        # the fabric is ICI and this knob is untouched). Only with > 1
+        # process: gloo requires the distributed client, which a
+        # single-process job never initializes — setting it there kills
+        # backend creation with an opaque "distributed_client: NoneType".
+        if num_processes > 1:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
     if num_processes > 1:
         if rank != 0:
             _await_coordinator(coordinator, rank)

@@ -128,12 +128,17 @@ def main():
         # the lm_head tile recompute (~10% of executed FLOPs at this
         # shape) for a dW residual in the lm_head param dtype (f32 here:
         # ~1 GB at D=2048, V=128256); sweep batch x chunk around the
-        # incumbent
+        # incumbent. Carry the incumbent's full configuration except the
+        # forced inline=True (the carry invariant: a standalone phase-4
+        # re-run after phase 6/7 records exist must keep the incumbent's
+        # mu_bf16 — a batch that only fits with a bf16 mu would
+        # otherwise re-run without it and record a spurious OOM).
+        p4_carry = {**_carry(b), "inline": True}
         inline_recs = []
         for batch in (4, 8, 12, 16):
             inline_recs.append(
                 run_one(f"p4-inline-b{batch}", batch=batch,
-                        policy=b["policy"], chunk=b["chunk"], inline=True))
+                        policy=b["policy"], chunk=b["chunk"], **p4_carry))
         done = [r for r in inline_recs if "tokens_per_sec" in r]
         if done:
             # chunk sweep continues from the best INLINE point (inline
@@ -142,7 +147,9 @@ def main():
             bi = max(done, key=lambda r: r["tokens_per_sec"])
             for chunk in (2048, 8192, 16384):
                 run_one(f"p4-inline-chunk{chunk}", batch=bi["batch"],
-                        policy=bi["policy"], chunk=chunk, inline=True)
+                        policy=bi["policy"], chunk=chunk,
+                        **{**p4_carry,
+                           "mu_bf16": bi.get("mu_bf16", False)})
     if phase in ("5", "all"):
         # remat_policy="attn_out" (save flash VJP residuals, skip the
         # attention share of the backward recompute — VERDICT r4 next #2's

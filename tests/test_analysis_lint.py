@@ -387,3 +387,108 @@ def test_rlt401_suppressible():
         "    g = WorkerGroup(4)  # rlt: disable=RLT401\n"
         "    g.start()\n")
     assert fs == []
+
+
+# ---- RLT304 host sync in hot loop ----------------------------------------
+
+
+def test_rlt304_host_syncs_on_step_outputs():
+    fs = lint(
+        "import jax, numpy as np\n"
+        "def train(loader, train_step, state):\n"
+        "    for batch in loader:\n"
+        "        state, metrics = train_step(state, batch)\n"
+        "        a = float(metrics['loss'])\n"
+        "        b = np.asarray(metrics['acc'])\n"
+        "        metrics['loss'].block_until_ready()\n"
+        "        c = metrics['loss'].item()\n")
+    assert rules_of(fs) == ["RLT304"]
+    assert len(fs) == 4
+    assert all(f.symbol == "train" for f in fs)
+
+
+def test_rlt304_unprefetched_device_put():
+    fs = lint(
+        "import jax\n"
+        "def train(dataloader, step, state):\n"
+        "    for batch in dataloader:\n"
+        "        db = jax.device_put(batch)\n"
+        "        state, _ = step(state, db)\n")
+    assert rules_of(fs) == ["RLT304"]
+    assert "device_put" in fs[0].message
+
+
+def test_rlt304_log_cadence_exempt():
+    fs = lint(
+        "def train(loader, train_step, state, step_no):\n"
+        "    for batch in loader:\n"
+        "        state, metrics = train_step(state, batch)\n"
+        "        if step_no % 50 == 0:\n"
+        "            print(float(metrics['loss']))\n")
+    assert fs == []
+
+
+def test_rlt304_quiet_outside_loader_loops_and_after_loop():
+    # non-loader iteration: not a hot loop
+    fs = lint(
+        "def f(xs, step):\n"
+        "    for x in xs:\n"
+        "        y = step(x)\n"
+        "        z = float(y)\n")
+    assert fs == []
+    # sync AFTER the loop (the trainer's own pending-metrics pattern)
+    fs = lint(
+        "def train(loader, train_step, state):\n"
+        "    pending = None\n"
+        "    for batch in loader:\n"
+        "        state, pending = train_step(state, batch)\n"
+        "    return float(pending['loss'])\n")
+    assert fs == []
+    # non-step values inside a loader loop: not flagged
+    fs = lint(
+        "def show(loader):\n"
+        "    for batch in loader:\n"
+        "        n = float(batch['x'][0])\n")
+    assert fs == []
+
+
+def test_rlt304_module_level_script_and_enumerate():
+    fs = lint(
+        "for i, batch in enumerate(val_loader):\n"
+        "    m = eval_step(params, batch)\n"
+        "    t = m.item()\n")
+    assert rules_of(fs) == ["RLT304"]
+
+
+def test_rlt304_not_in_traced_code():
+    # inside a traced step the per-step sync is RLT201's business
+    fs = lint(
+        "import numpy as np\n"
+        "class M:\n"
+        "    def training_step(self, params, batch, rng):\n"
+        "        for b in batch_loader:\n"
+        "            x = np.asarray(b)\n"
+        "        return x\n")
+    assert "RLT304" not in rules_of(fs)
+
+
+def test_rlt304_suppressible():
+    fs = lint(
+        "def train(loader, train_step, state):\n"
+        "    for batch in loader:\n"
+        "        state, m = train_step(state, batch)\n"
+        "        loss = float(m['loss'])  # rlt: disable=RLT304\n")
+    assert fs == []
+
+
+def test_rlt304_nested_hot_loops_report_once():
+    # a loader loop inside a loader loop: each finding belongs to
+    # exactly ONE loop's pass — never doubled
+    fs = lint(
+        "def train(loader, batch_loader, step, s):\n"
+        "    for batch in loader:\n"
+        "        for b2 in batch_loader:\n"
+        "            s, m = step(s, b2)\n"
+        "            x = float(m)\n")
+    assert rules_of(fs) == ["RLT304"]
+    assert len(fs) == 1, [f.format() for f in fs]

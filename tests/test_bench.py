@@ -334,3 +334,42 @@ def test_sigterm_flushes_structured_json():
     obj = json.loads(out.strip().splitlines()[-1])
     assert obj["skipped"] == "killed: SIGTERM"
     assert obj["value"] == 0.0
+
+
+def test_attnout_leg_fallback_and_double_failure_chaining():
+    """ADVICE r5: the attn_out leg falls back to the non-inline config
+    with the inline cause preserved; when the fallback ALSO fails, both
+    causes must survive — folded into the raised message, inline chained
+    as __cause__ — instead of the inline root cause being discarded."""
+    class Cfg:
+        pass
+
+    def inline_only_fails(ce_inline):
+        if ce_inline:
+            raise RuntimeError("inline compile rejected")
+        return 800.0, Cfg()
+
+    row, m = bench._attnout_leg(inline_only_fails, lambda t, c: 0.3)
+    assert row["flagship_attnout_tokens_per_sec"] == 800.0
+    assert m == 0.3
+    assert "inline compile rejected" in row["flagship_attnout_inline_error"]
+
+    def both_fail(ce_inline):
+        if ce_inline:
+            raise RuntimeError("inline compile rejected")
+        raise MemoryError("fallback OOM")
+
+    with pytest.raises(RuntimeError) as ei:
+        bench._attnout_leg(both_fail, lambda t, c: 0.3)
+    msg = str(ei.value)
+    assert "inline compile rejected" in msg  # first cause kept
+    assert "fallback OOM" in msg             # second cause kept
+    assert isinstance(ei.value.__cause__, RuntimeError)
+    assert "inline compile rejected" in str(ei.value.__cause__)
+
+    def ok(ce_inline):
+        return 1200.0, Cfg()
+
+    row, m = bench._attnout_leg(ok, lambda t, c: 0.6)
+    assert row["flagship_attnout_tokens_per_sec"] == 1200.0
+    assert "flagship_attnout_inline_error" not in row

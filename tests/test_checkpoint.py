@@ -185,19 +185,32 @@ def test_epoch_boundary_resume_advances_epoch(tmp_path):
 
 
 def test_async_meta_deferred_until_finalized(tmp_path):
-    """block=False must not drop meta.json (the completeness marker) until
-    the state write has finalized — wait_for_checkpoints() writes it."""
+    """block=False must not write meta.json (the completeness marker)
+    over a still-streaming state dir. The background finalizer publishes
+    it EAGERLY once the state write commits (a crash between cadences
+    must not cost a finished checkpoint its marker), so the invariant is
+    ordering, not absence: meta present ⇒ state finalized + verifiable;
+    wait_for_checkpoints() guarantees it afterwards."""
     import jax.numpy as jnp
 
-    from ray_lightning_tpu.checkpoint import wait_for_checkpoints
+    from ray_lightning_tpu.checkpoint import (
+        verify_checkpoint,
+        wait_for_checkpoints,
+    )
     from ray_lightning_tpu.checkpoint.io import read_meta
 
     path = str(tmp_path / "ck")
     save_checkpoint(path, {"w": jnp.ones((4,))}, {"epoch": 3}, block=False)
-    assert not os.path.exists(os.path.join(path, "meta.json"))
+    if os.path.exists(os.path.join(path, "meta.json")):
+        # the eager finalizer won the race — then the state MUST already
+        # be complete and the digest must check out
+        ok, reason = verify_checkpoint(path)
+        assert ok, reason
     wait_for_checkpoints()
     assert os.path.exists(os.path.join(path, "meta.json"))
     assert read_meta(path)["epoch"] == 3
+    ok, reason = verify_checkpoint(path)
+    assert ok, reason
 
 
 def test_async_save_with_top_k_prune(tmp_path):

@@ -386,3 +386,41 @@ def test_find_max_batch_8b_north_star():
     )
     assert local >= 1, plan.summary()
     assert plan.fits, plan.summary()
+
+
+def test_activation_bytes_attnout_gated_on_remat_and_dtype():
+    """ADVICE r5: the attn_out residual charge applies only when remat
+    is actually on (the model documents the policy as ignored with
+    remat=False), and is charged at cfg.dtype's width, not an assumed
+    2 B/elem."""
+    import jax.numpy as jnp
+
+    base = _cfg_8b(remat_policy="nothing")
+    attn = _cfg_8b(remat_policy="attn_out")
+    plain = llama_activation_bytes(base, local_batch=1, seq=8192)
+    saved = llama_activation_bytes(attn, local_batch=1, seq=8192)
+    assert saved > plain  # remat=True + attn_out charges the residuals
+
+    # remat off: policy documented as ignored -> identical charge
+    import dataclasses
+
+    attn_no_remat = dataclasses.replace(attn, remat=False)
+    base_no_remat = dataclasses.replace(base, remat=False)
+    assert (llama_activation_bytes(attn_no_remat, 1, 8192)
+            == llama_activation_bytes(base_no_remat, 1, 8192))
+
+    # f32 compute dtype: the residual share doubles vs bf16 (4 B vs 2 B
+    # per element; the f32 logsumexp term is dtype-independent)
+    attn_f32 = _cfg_8b(remat_policy="attn_out", dtype=jnp.float32)
+    delta_bf16 = saved - plain
+    delta_f32 = (llama_activation_bytes(attn_f32, 1, 8192)
+                 - llama_activation_bytes(
+                     _cfg_8b(remat_policy="nothing", dtype=jnp.float32),
+                     1, 8192))
+    hd = attn.head_dim
+    lse = attn.n_layers * 8192 * attn.n_heads * 4
+    resid_bf16 = attn.n_layers * 8192 * (
+        (2 * attn.n_heads + 2 * attn.n_kv_heads) * hd * 2)
+    resid_f32 = resid_bf16 * 2
+    assert delta_bf16 == int(1.5 * (resid_bf16 + lse))
+    assert delta_f32 == int(1.5 * (resid_f32 + lse))

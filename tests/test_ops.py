@@ -248,3 +248,72 @@ def test_flash_block_env_nonpositive_falls_back(monkeypatch):
             assert flash_mod._env_block(
                 "RLT_FLASH_BLOCK_Q", flash_mod.DEFAULT_BLOCK_Q
             ) == flash_mod.DEFAULT_BLOCK_Q
+
+
+class TestAttnOutPolicyScope:
+    def test_foreign_remat_opt_not_saved(self):
+        """ADVICE r5: remat_policy='attn_out' is scoped to the FLASH
+        kernel's hoisted fwd rule (fingerprinted by its
+        'flash_residuals' checkpoint_name). Any other custom_vjp defined
+        with optimize_remat=True must keep its default remat fate, not
+        silently have its residuals saved."""
+        import contextlib
+        import io
+
+        from jax.ad_checkpoint import print_saved_residuals
+
+        from ray_lightning_tpu.models.llama import _remat_policy
+
+        @jax.custom_vjp
+        def f(x):
+            return jnp.sin(x)
+
+        def f_fwd(x):
+            return jnp.sin(x), (x,)
+
+        def f_bwd(res, g):
+            return (g * jnp.cos(res[0]),)
+
+        f.defvjp(f_fwd, f_bwd, optimize_remat=True)
+
+        def loss(x):
+            return f(x * 2).sum()
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            print_saved_residuals(
+                jax.checkpoint(loss, policy=_remat_policy("attn_out")),
+                jnp.ones(16))
+        assert "remat_opt" not in buf.getvalue(), buf.getvalue()
+
+    def test_pallas_branch_skips_redundant_attn_out_name(self, monkeypatch):
+        """On the pallas flash path the block-level checkpoint_name is
+        dropped (the kernel's own residual set already saves o — naming
+        it again would double-save a [B,S,H*hd] tensor per layer); the
+        XLA-reference path keeps the name as its only save point."""
+        import numpy as np
+
+        from ray_lightning_tpu.models.llama import Llama, LlamaConfig
+
+        def jaxpr_names(use_flash, env):
+            if env:
+                monkeypatch.setenv("RLT_PALLAS", "1")
+            else:
+                monkeypatch.delenv("RLT_PALLAS", raising=False)
+            cfg = LlamaConfig(
+                vocab_size=64, dim=256, n_layers=1, n_heads=4,
+                n_kv_heads=2, hidden_dim=128, max_seq_len=128,
+                use_flash=use_flash, dtype=jnp.float32, remat=False,
+                scan_layers=False)
+            model = Llama(cfg)
+            tokens = np.zeros((2, 128), np.int32)
+            params = jax.eval_shape(
+                lambda: model.init(jax.random.key(0), tokens))
+            jaxpr = jax.make_jaxpr(
+                lambda p, t: model.apply(p, t))(params, tokens)
+            return str(jaxpr)
+
+        # pallas path (forced in interpret mode): no block-level name
+        assert "name=attn_out" not in jaxpr_names(True, env=True)
+        # XLA reference path: the name is the policy's save point
+        assert "name=attn_out" in jaxpr_names(False, env=False)

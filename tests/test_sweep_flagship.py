@@ -79,3 +79,35 @@ def test_best_so_far_keeps_full_config(results_path):
     # without it)
     assert best["tag"] == "b"
     assert best["inline"] is True and best["mu_bf16"] is True
+
+
+def test_phase4_carries_incumbent_mu_bf16(results_path, monkeypatch):
+    """ADVICE r5: a standalone phase-4 re-run after phase 6/7 records
+    exist must carry the incumbent's mu_bf16 (minus the forced
+    inline=True) — a batch that only fits with a bf16 mu must not be
+    silently re-run without it and recorded as a spurious OOM."""
+    import sys
+
+    import scripts.sweep_flagship as sf
+
+    # seed the record with a phase-6-style incumbent: bf16 mu, inline
+    with open(results_path, "a") as f:
+        f.write(json.dumps({
+            "tag": "p6-mubf16-b12-inline", "batch": 12,
+            "policy": "nothing", "chunk": 4096,
+            "inline": True, "mu_bf16": True,
+            "tokens_per_sec": 999.0}) + "\n")
+
+    calls = []
+
+    def record_run_one(tag, **kw):
+        calls.append({"tag": tag, **kw})
+        return {"tag": tag, **kw}  # no tokens_per_sec: chunk sweep skipped
+
+    monkeypatch.setattr(sf, "run_one", record_run_one)
+    monkeypatch.setattr(sys, "argv", ["sweep_flagship.py", "4"])
+    sf.main()
+    p4 = [c for c in calls if c["tag"].startswith("p4-")]
+    assert p4, calls
+    assert all(c["inline"] is True for c in p4)
+    assert all(c["mu_bf16"] is True for c in p4)
