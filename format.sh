@@ -29,10 +29,15 @@ JAX_PLATFORMS=cpu python -m ray_lightning_tpu lint \
 JAX_PLATFORMS=cpu python -m ray_lightning_tpu trace llama3-8b \
     --topo v5p-64 --json --fail-on error > /dev/null
 
-# resilience gate: a supervised CPU-SPMD run with one injected worker
-# kill must auto-resume from the step-cadence checkpoint and converge
-# (rc=0) — proves kill -> classify -> relaunch -> resume end to end on a
-# box with no accelerator. docs/RESILIENCE.md "fault-injection cookbook".
+# resilience gate, three supervised CPU-SPMD legs: (1) an injected
+# worker kill must auto-resume from the step-cadence checkpoint and
+# converge (kill -> classify -> relaunch -> resume, end to end); (2) an
+# injected NaN batch must be SKIPPED IN-JIT by the trainguard (zero
+# restarts) and converge; (3) an injected parameter bit-flip on rank 1
+# must be caught by the SDC fingerprint probe within one cadence, rank
+# 1 quarantined, and the rolled-back run must converge — all on a box
+# with no accelerator. docs/RESILIENCE.md "trainguard" +
+# "fault-injection cookbook".
 JAX_PLATFORMS=cpu python -m ray_lightning_tpu supervise --smoke > /dev/null
 
 # prefetch-overlap gate: a slow-loader CPU run must show pipeline

@@ -40,6 +40,8 @@ from ray_lightning_tpu.utils import seed_everything, simulate_cpu_devices
 from ray_lightning_tpu import pipeline, sweep
 from ray_lightning_tpu.pipeline import DevicePrefetcher
 from ray_lightning_tpu.resilience import (
+    GuardCallback,
+    GuardConfig,
     ResilienceConfig,
     RetryPolicy,
     SupervisedResult,
@@ -78,6 +80,8 @@ __all__ = [
     "sweep",
     "pipeline",
     "DevicePrefetcher",
+    "GuardCallback",
+    "GuardConfig",
     "ResilienceConfig",
     "RetryPolicy",
     "SupervisedResult",

@@ -38,8 +38,11 @@ cache. ``--smoke`` is the format.sh gate (pipeline occupancy must be
 
 ``supervise`` runs a distributed fit under the resilience supervisor
 (resilience/supervisor.py, docs/RESILIENCE.md): transient failures
-restart the worker group and resume from the latest valid checkpoint.
-``--smoke`` is the CPU fault-injection convergence gate format.sh runs:
+restart the worker group and resume from the latest valid checkpoint;
+trainguard corruption escalations roll back to the last blessed one.
+``--smoke`` is the CPU fault-injection convergence gate format.sh runs
+(worker kill + the trainguard legs: injected NaN must skip in-jit,
+injected parameter bit-flip must quarantine the rank):
 
     python -m ray_lightning_tpu supervise --smoke
     python -m ray_lightning_tpu supervise my_project.jobs:make_job \\

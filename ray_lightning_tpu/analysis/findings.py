@@ -110,6 +110,14 @@ RULES: Dict[str, Rule] = {r.id: r for r in (
          "garbage"),
     # RLT4xx — resilience anti-patterns (docs/RESILIENCE.md): code shapes
     # that defeat the supervision layer's failure classification.
+    Rule("RLT402", "nan-through-where", "warning",
+         "jnp.where(cond, f(x), safe) with f in log/sqrt/div/pow "
+         "evaluates BOTH branches under jit: the untaken branch's NaN/"
+         "inf flows back through its cotangent and poisons the whole "
+         "gradient (the trap the trainguard then has to skip at "
+         "runtime). Mask the INPUT (jnp.where(cond, x, 1.0) inside f), "
+         "not the output. Also fires on unguarded jnp.log/jnp.sqrt of "
+         "raw batch values in traced code"),
     Rule("RLT401", "unsupervised-worker-failure", "warning",
          "a bare/broad except silently swallows worker-group failures "
          "(WorkerError never reaches the supervisor, so a dead rank "

@@ -395,6 +395,115 @@ def _own_nodes(fn_node) -> Iterable[ast.AST]:
         stack.extend(ast.iter_child_nodes(node))
 
 
+# ---- RLT402: NaN through the untaken where branch --------------------------
+
+#: math whose derivative (or value) is non-finite outside its domain —
+#: the functions the classic jnp.where gradient trap involves
+_RLT402_RISKY: Set[str] = {
+    "log", "log1p", "log2", "log10", "sqrt", "rsqrt", "reciprocal",
+    "divide", "true_divide", "power", "float_power",
+    "arcsin", "arccos", "arctanh",
+}
+
+#: wrappers that mask/clamp the INPUT — their subtree is considered
+#: guarded and never flagged
+_RLT402_GUARDS: Set[str] = {
+    "clip", "maximum", "minimum", "abs", "where", "nan_to_num",
+    "relu", "softplus", "exp", "clamp", "logaddexp", "logsumexp",
+}
+
+_RLT402_ROOTS = ("jnp", "jax")
+
+
+def _rlt402_is_jnp(name: Optional[str]) -> bool:
+    return bool(name) and (name.startswith("jnp.")
+                           or name.startswith("jax.numpy."))
+
+
+def _rlt402_risky_in(expr: ast.AST) -> Optional[str]:
+    """A risky op inside ``expr`` (skipping guarded subtrees), described
+    for the message, else None."""
+    stack: List[ast.AST] = [expr]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func) or ""
+            last = name.split(".")[-1]
+            if last in _RLT402_GUARDS:
+                continue  # the input is masked — do not descend
+            if _rlt402_is_jnp(name) and last in _RLT402_RISKY:
+                if node.args and _rlt402_guarded(node.args[0]):
+                    continue  # f(clamped_input): the sanctioned fix
+                return f"{name}()"
+        elif isinstance(node, ast.BinOp):
+            if isinstance(node.op, ast.Div) and not _rlt402_guarded(
+                    node.right):
+                # x / jnp.maximum(d, eps) is the sanctioned fix and
+                # must not be flagged — only an unguarded denominator
+                return "a division"
+            if isinstance(node.op, ast.Pow) and _rlt402_pow_risky(node):
+                return "a power"
+        stack.extend(ast.iter_child_nodes(node))
+    return None
+
+
+def _rlt402_pow_risky(node: ast.BinOp) -> bool:
+    """x ** k is finite-gradient for positive-integer constant k; only
+    fractional/negative/variable exponents (x**0.5 == sqrt, x**-1 ==
+    reciprocal) hit the invalid-domain trap — and a clamped base is the
+    sanctioned fix."""
+    exp = node.right
+    if (isinstance(exp, ast.Constant) and isinstance(exp.value, int)
+            and exp.value >= 1):
+        return False
+    return not _rlt402_guarded(node.left)
+
+
+def _rlt402_guarded(expr: ast.AST) -> bool:
+    """True when the expression already masks its input: a guard call
+    anywhere inside, or an additive epsilon (x + 1e-6)."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            last = (_dotted(node.func) or "").split(".")[-1]
+            if last in _RLT402_GUARDS:
+                return True
+        elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+            return True
+    return False
+
+
+def _lint_rlt402_call(lint: _FileLint, node: ast.Call,
+                      fname: Optional[str], sym: str) -> None:
+    if _rlt402_is_jnp(fname) and fname.split(".")[-1] == "where" \
+            and len(node.args) == 3:
+        for branch, which in ((node.args[1], "taken"),
+                              (node.args[2], "untaken")):
+            risky = _rlt402_risky_in(branch)
+            if risky:
+                lint.add(
+                    "RLT402",
+                    f"{risky} inside a jnp.where branch: under jit "
+                    "BOTH branches evaluate, and the "
+                    f"{which}-branch NaN/inf flows back through its "
+                    "cotangent into the whole gradient — mask the "
+                    "INPUT (jnp.where(cond, x, 1.0) inside the op), "
+                    "not the output", node, sym)
+                break  # one finding per where-call is enough
+        return
+    if (_rlt402_is_jnp(fname)
+            and fname.split(".")[-1] in ("log", "log1p", "log2",
+                                         "log10", "sqrt", "rsqrt")
+            and node.args):
+        arg = node.args[0]
+        if _root_name(arg) == "batch" and not _rlt402_guarded(arg):
+            lint.add(
+                "RLT402",
+                f"{fname}() on a raw batch value: one out-of-domain "
+                "row (a zero, a negative) makes the loss NaN for the "
+                "whole step — clamp or shift the input "
+                "(jnp.maximum(x, eps)) before the transform", node, sym)
+
+
 def _lint_traced_body(lint: _FileLint, fn: _Func) -> None:
     sym = fn.qualname
     for node in _own_nodes(fn.node):
@@ -429,6 +538,8 @@ def _lint_traced_body(lint: _FileLint, fn: _Func) -> None:
                          "print() in traced code fires once, at trace "
                          "time, showing tracers not values — use "
                          "jax.debug.print for runtime values", node, sym)
+            else:
+                _lint_rlt402_call(lint, node, fname, sym)
         elif isinstance(node, (ast.For, ast.AsyncFor)):
             what = _is_unordered_iterable(node.iter)
             if what:

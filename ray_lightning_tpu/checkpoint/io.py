@@ -299,12 +299,22 @@ def verify_checkpoint(path: str) -> Tuple[bool, str]:
     return True, "ok"
 
 
-def latest_checkpoint(directory: str) -> Optional[str]:
+def latest_checkpoint(directory: str, *, good_only: bool = False,
+                      max_step: Optional[int] = None) -> Optional[str]:
     """Newest VALID checkpoint under ``directory`` (the dir itself is
     also considered, so both a checkpoint path and a dir of checkpoints
     work). Candidates ordered by recorded global_step (mtime breaks
     ties), newest first; torn/partial/corrupt candidates are skipped
-    with a logged reason. None when nothing valid exists."""
+    with a logged reason. None when nothing valid exists.
+
+    ``good_only=True`` additionally requires the trainguard blessing
+    (``meta["blessed"]`` — checkpoints saved inside an anomaly window
+    are stamped False and skipped; checkpoints without the field, e.g.
+    pre-guard ones, count as blessed). ``max_step`` caps the candidate's
+    recorded global_step — a corruption rollback passes the last
+    known-good step so a blessed-but-possibly-poisoned newer checkpoint
+    (an SDC bit-flip is silent until the probe catches it) is never the
+    resume source."""
     directory = os.path.abspath(directory)
     if not os.path.isdir(directory):
         return None
@@ -317,12 +327,23 @@ def latest_checkpoint(directory: str) -> Optional[str]:
         if not os.path.isdir(os.path.join(cand, _STATE_DIR)):
             continue
         step = -1
+        blessed = None
         meta_path = os.path.join(cand, _META_FILE)
         try:
             with open(meta_path) as f:
-                step = int(json.load(f).get("global_step", -1))
+                meta = json.load(f)
+            step = int(meta.get("global_step", -1))
+            blessed = meta.get("blessed")
         except (OSError, ValueError, TypeError):
             pass  # still a candidate; verify_checkpoint rejects it below
+        if good_only and blessed is False:
+            log.info("skipping unblessed checkpoint %s (saved inside a "
+                     "trainguard anomaly window)", cand)
+            continue
+        if max_step is not None and step > max_step:
+            log.info("skipping checkpoint %s: step %d is past the "
+                     "rollback horizon %d", cand, step, max_step)
+            continue
         try:
             mtime = os.path.getmtime(cand)
         except OSError:
@@ -411,6 +432,20 @@ def _discard_locked(path: str) -> bool:
     if had:
         _PENDING_META[:] = [(pp, m) for pp, m in _PENDING_META if pp != p]
     return had
+
+
+def pending_meta_for(path: str) -> Optional[Dict[str, Any]]:
+    """The deferred meta of an in-flight ASYNC save of `path`, if one is
+    queued (a copy; the real one is published by the finalizer). Lets
+    same-process readers — checkpoint retention deciding whether the
+    newest save is blessed — see the stamps before meta.json lands,
+    instead of misreading a streaming write as 'unknown'."""
+    p = os.path.abspath(path)
+    with _META_LOCK:
+        for pp, meta in _PENDING_META:
+            if pp == p:
+                return dict(meta)
+    return None
 
 
 def discard_pending_meta(path: str) -> bool:

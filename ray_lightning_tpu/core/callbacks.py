@@ -23,6 +23,13 @@ class Callback:
     def on_fit_start(self, trainer, module) -> None: ...
     def on_fit_end(self, trainer, module) -> None: ...
     def on_train_epoch_start(self, trainer, module) -> None: ...
+    def on_train_batch_start(self, trainer, module, batch,
+                             batch_idx: int):
+        """Before the step dispatches. Return a (device) batch to
+        REPLACE the one about to be trained on, or None to leave it —
+        the fault injector's batch-poisoning kinds use this seam."""
+        return None
+
     def on_train_batch_end(self, trainer, module, metrics: Dict[str, Any],
                            batch_idx: int) -> None: ...
     def on_train_epoch_end(self, trainer, module) -> None: ...
@@ -144,7 +151,7 @@ class ModelCheckpoint(Callback):
             if self.save_last:
                 self.last_model_path = path
             self._saved.append((-float(trainer.global_step), path))
-            self._prune()
+            self._prune(trainer)
             return
         score = self._score(metrics)
         if self.monitor is not None and score is None:
@@ -157,14 +164,14 @@ class ModelCheckpoint(Callback):
             # Unmonitored: "best" is the most recent; prune to save_top_k.
             self.best_model_path = path
             self._saved.append((-float(trainer.global_step), path))
-            self._prune()
+            self._prune(trainer)
             return
         sign = 1.0 if self.mode == "min" else -1.0
         self._saved.append((sign * score, path))
         if self.best_model_score is None or sign * score < sign * self.best_model_score:
             self.best_model_score = score
             self.best_model_path = path
-        self._prune()
+        self._prune(trainer)
 
     def _dedupe(self, path: str) -> None:
         # re-saving an existing path must replace, not duplicate, its
@@ -172,14 +179,53 @@ class ModelCheckpoint(Callback):
         # only on the branches that actually save to `path`.
         self._saved = [(s, p) for s, p in self._saved if p != path]
 
-    def _prune(self) -> None:
+    def _prune(self, trainer=None) -> None:
         if self.save_top_k <= 0:
             return
         self._saved.sort(key=lambda t: t[0])
-        for _, stale in self._saved[self.save_top_k:]:
-            if stale not in (self.best_model_path, self.last_model_path):
-                _remove_checkpoint(stale)
-        self._saved = self._saved[: self.save_top_k]
+        keep = self._saved[: self.save_top_k]
+        stale = self._saved[self.save_top_k:]
+        # Retention floor (trainguard, docs/RESILIENCE.md): a corruption
+        # rollback needs a checkpoint that is (a) explicitly blessed —
+        # NOT saved inside an anomaly window; an unreadable/absent
+        # blessing reads as "not known good", never as "safe to delete
+        # the fallback" — and, when the SDC probe is armed, (b) at or
+        # below the last probe-VERIFIED step (an SDC bit-flip is silent,
+        # so newer checkpoints are blessed yet possibly poisoned). When
+        # no kept checkpoint qualifies, the best-ranked stale one that
+        # does is protected from pruning: a long anomaly streak (or a
+        # probe cadence longer than the prune window) must never GC the
+        # last good restore point.
+        horizon = getattr(trainer, "_guard_probe_ok_step", None) \
+            if trainer is not None else None
+
+        def rollback_ok(path: str, max_step) -> bool:
+            blessed, step = _ckpt_meta(path)
+            if blessed is not True:
+                return False
+            return max_step is None or (step is not None
+                                        and step <= max_step)
+
+        protected: list[tuple[float, str]] = []
+        if stale and keep:
+            for need in ([None, horizon] if horizon is not None
+                         else [None]):
+                retained = keep + protected
+                if not any(rollback_ok(p, need) for _, p in retained):
+                    hit = next(
+                        (e for e in stale
+                         if e not in protected and rollback_ok(e[1], need)),
+                        None)
+                    if hit is not None:
+                        protected.append(hit)
+        protected_paths = {p for _, p in protected}
+        for _, stale_path in stale:
+            if stale_path in protected_paths:
+                continue
+            if stale_path not in (self.best_model_path,
+                                  self.last_model_path):
+                _remove_checkpoint(stale_path)
+        self._saved = keep + protected
 
     def on_train_batch_end(self, trainer, module, metrics, batch_idx) -> None:
         if (self.every_n_train_steps
@@ -271,6 +317,37 @@ class ProgressLogger(Callback):
                       for k, v in metrics.items()}
             log.info("epoch %d step %d %s", trainer.current_epoch,
                      trainer.global_step, pretty)
+
+
+def _ckpt_meta(path: str):
+    """(blessed, global_step) from a checkpoint's meta.json — the
+    trainguard blessing is True/False when stamped, None when absent or
+    unreadable (pre-guard checkpoints and foreign dirs read as "not
+    known good", which the retention floor treats conservatively). An
+    in-flight ASYNC save whose meta.json has not landed yet is resolved
+    from this process's deferred-meta queue, so the newest save never
+    misreads as unknown and inflates retention."""
+    import json
+
+    try:
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+    except (OSError, ValueError):
+        from ray_lightning_tpu.checkpoint.io import pending_meta_for
+
+        meta = pending_meta_for(path)
+        if meta is None:
+            return None, None
+    blessed = meta.get("blessed")
+    try:
+        step = int(meta.get("global_step"))
+    except (TypeError, ValueError):
+        step = None
+    return (None if blessed is None else bool(blessed)), step
+
+
+def _ckpt_blessed(path: str):
+    return _ckpt_meta(path)[0]
 
 
 def _remove_checkpoint(path: str) -> None:

@@ -19,6 +19,12 @@ class TrainState:
     step: jnp.ndarray  # scalar int32
     params: Any
     opt_state: Any
+    #: trainguard slice (resilience/guard.py GuardState) — a handful of
+    #: replicated scalars carrying the loss EMA + anomaly counters
+    #: through the jitted step. The empty-tuple default contributes no
+    #: pytree leaves, so unguarded training compiles the exact same
+    #: program as before the guard existed.
+    guard: Any = ()
 
     @classmethod
     def create(cls, params: Any, tx: optax.GradientTransformation) -> "TrainState":

@@ -85,6 +85,7 @@ class Trainer:
         prefetch_to_device: int = 2,
         warm_start: bool = True,
         compile_cache_dir: Optional[str] = None,
+        guard: Any = None,
     ):
         self.strategy = strategy or SingleDevice()
         self.max_epochs = max_epochs
@@ -119,6 +120,18 @@ class Trainer:
         #: persistent XLA compilation cache dir; restarts (resilience
         #: supervisor) then deserialize the step instead of recompiling.
         self.compile_cache_dir = compile_cache_dir
+        #: trainguard (resilience/guard.py): True / GuardConfig compiles
+        #: finiteness + loss-spike checks INTO the train step — an
+        #: anomalous update is discarded by a tree-select, the counters
+        #: ride the existing metric outputs (no new host syncs), and a
+        #: GuardCallback escalates sustained anomalies / SDC verdicts.
+        self.guard = guard
+        #: trainguard rollback marker payload (set by the supervisor's
+        #: worker wrapper): after a corruption rollback, resume advances
+        #: the data order past the poisoned window instead of replaying
+        #: it. Applied in _init_state when the restore point is behind
+        #: the marker's detection step.
+        self.resume_skip_past: Optional[Dict[str, Any]] = None
 
         self.callbacks: List[Callback] = list(callbacks or [])
         if enable_checkpointing and not any(
@@ -173,6 +186,18 @@ class Trainer:
         self._base_rng = jax.random.key(seed)
         self.module = module
         module.trainer = self
+        if self.guard:
+            # normalize (True -> defaults) and attach the escalation/SDC
+            # callback; lazy import keeps core free of resilience deps
+            # when the guard is off
+            from ray_lightning_tpu.resilience.guard import (
+                GuardCallback,
+                GuardConfig,
+            )
+
+            self.guard = GuardConfig.coerce(self.guard)
+            if not any(isinstance(c, GuardCallback) for c in self.callbacks):
+                self.callbacks.append(GuardCallback(self.guard))
         # mesh first: configure_model may close over it (ring attention).
         self.strategy.setup(module)
         module.setup()
@@ -300,6 +325,8 @@ class Trainer:
                     completed = True
                     break
                 self.last_batch_size = bs
+                device_batch = self._invoke_batch_start(
+                    device_batch, batch_idx)
                 self.state, metrics = self._train_step(
                     self.state, device_batch, self._base_rng
                 )
@@ -433,6 +460,25 @@ class Trainer:
             "module_class": type(self.module).__name__,
             "hparams": self.module.hparams,
         }
+        # trainguard blessing: stamp the anomaly-free-window verdict so
+        # a corruption rollback can target the last GOOD restore point
+        # (latest_checkpoint(good_only=True)). Guard off => trivially
+        # blessed. The counter fetch below is save-cadenced host work, a
+        # rounding error next to the checkpoint write it accompanies.
+        blessed = True
+        if self.guard and not isinstance(
+                getattr(self.state, "guard", ()), tuple):
+            from ray_lightning_tpu.resilience.guard import bless_verdict
+
+            g = jax.device_get(self.state.guard)
+            upd = int(jax.device_get(self.state.step))
+            blessed = bless_verdict(self.guard, g, upd)
+            ckpt_meta["guard"] = {
+                "skipped_steps": int(np.asarray(g.skipped)),
+                "streak": int(np.asarray(g.streak)),
+                "last_anomaly": int(np.asarray(g.last_anomaly)),
+            }
+        ckpt_meta["blessed"] = blessed
         checkpoint = {
             "params": self.state.params,
             "opt_state": self.state.opt_state,
@@ -551,11 +597,55 @@ class Trainer:
                 params=restored["params"],
                 opt_state=restored["opt_state"],
             )
+        # outside the restore branch on purpose: a rollback that found
+        # no blessed checkpoint resumes from SCRATCH and must still
+        # advance past the poisoned window instead of replaying it
+        self._apply_rollback_skip()
+        if self.guard:
+            from ray_lightning_tpu.resilience.guard import init_guard_state
+
+            # fresh guard scalars even after a restore: the EMA re-warms
+            # in warmup_steps, which beats resuming a pre-anomaly EMA
+            # that no longer matches the restored loss scale
+            state = state.replace(guard=jax.device_put(
+                init_guard_state(), self.strategy.replicated()))
         return state
+
+    def _apply_rollback_skip(self) -> None:
+        """After a trainguard rollback (resume_skip_past set by the
+        supervisor from the rollback marker): the restore point is the
+        last BLESSED checkpoint, behind the detection step — advance the
+        data order past the poisoned window instead of replaying it.
+        Also applies to a scratch resume (no blessed checkpoint found):
+        the clean prefix of the epoch is sacrificed along with the
+        window, which is the safe trade — suspect data is never
+        retrained."""
+        rsp = self.resume_skip_past
+        if not rsp or int(rsp.get("detected_step", -1)) <= self.global_step:
+            return  # stale marker from an older incident: resume is past it
+        if int(rsp.get("epoch", -1)) != self.current_epoch:
+            log.warning(
+                "trainguard rollback: poisoned window spans an epoch "
+                "boundary (detected epoch %s, resuming epoch %d) — "
+                "replaying instead of skipping", rsp.get("epoch"),
+                self.current_epoch)
+            return
+        target = int(rsp.get("epoch_batch", 0))
+        if target > self._resume_skip_batches:
+            log.warning(
+                "trainguard rollback: advancing data order past the "
+                "poisoned window — epoch %d resumes at batch %d "
+                "(instead of %d)", self.current_epoch, target,
+                self._resume_skip_batches)
+            self._resume_skip_batches = target
 
     def _make_train_step(self, module: TpuModule):
         tx = self.tx
         accum = self.accumulate_grad_batches
+        guard_cfg = self.guard if (self.guard and self.guard.enabled) \
+            else None
+        if guard_cfg is not None:
+            from ray_lightning_tpu.resilience.guard import apply_guard
 
         def loss_fn(params, batch, rng):
             out = module.training_step(params, batch, rng)
@@ -591,11 +681,23 @@ class Trainer:
                 metrics = jax.tree.map(lambda m: m.mean(axis=0), metricses)
             updates, opt_state = tx.update(grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
-            metrics = {
-                "loss": loss,
-                "grad_norm": optax.global_norm(grads),
-                **metrics,
-            }
+            grad_norm = optax.global_norm(grads)
+            metrics = {"loss": loss, "grad_norm": grad_norm, **metrics}
+            if guard_cfg is not None:
+                # trainguard tier 1 (resilience/guard.py): an anomalous
+                # update (non-finite loss/grad or a loss spike vs the
+                # EMA) is discarded by a tree-select — params/opt-state/
+                # step pass through unchanged; the flag and counters are
+                # ordinary metric scalars riding the existing lazy fetch
+                params, opt_state, new_step, gstate, gmetrics = \
+                    apply_guard(guard_cfg, state.guard, state.step, loss,
+                                grad_norm, params, state.params,
+                                opt_state, state.opt_state)
+                return (
+                    state.replace(step=new_step, params=params,
+                                  opt_state=opt_state, guard=gstate),
+                    {**metrics, **gmetrics},
+                )
             return (
                 state.replace(
                     step=state.step + 1, params=params, opt_state=opt_state
@@ -713,6 +815,18 @@ class Trainer:
     def _invoke(self, hook: str, *args) -> None:
         for cb in self.callbacks:
             getattr(cb, hook)(self, self.module, *args)
+
+    def _invoke_batch_start(self, batch, batch_idx: int):
+        """on_train_batch_start with batch replacement: a callback that
+        returns a non-None value substitutes the device batch (the
+        fault injector's nan_loss/grad_blowup poisoning rides this).
+        Host-side per-batch dispatch only — no device sync."""
+        for cb in self.callbacks:
+            out = cb.on_train_batch_start(self, self.module, batch,
+                                          batch_idx)
+            if out is not None:
+                batch = out
+        return batch
 
     def _maybe_profile(self):
         if not self.profiler_dir:

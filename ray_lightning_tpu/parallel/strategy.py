@@ -254,6 +254,27 @@ class Strategy:
             label=label or f"{type(module).__name__} x "
                            f"{type(self).__name__}")
 
+    # ---- trainguard: SDC fingerprint probe -------------------------------
+
+    def sdc_probe(self, params):
+        """Build the trainguard silent-data-corruption probe for this
+        strategy's mesh (resilience/guard.py): a jitted ``shard_map`` in
+        which every device digests its OWN local parameter bytes
+        (bitcast-uint32 wraparound sum), gathered to one fingerprint per
+        device with a single small collective.
+
+        Returns ``(fn, devices, groups)``: ``fn(params) -> (n_devices,)``
+        uint32 fingerprints in ``mesh.devices.reshape(-1)`` order,
+        ``groups`` the replica groups whose members hold bit-identical
+        bytes by this strategy's sharding policy (pure DP: all devices;
+        pure FSDP: none — no redundancy to cross-check). Usable directly
+        for an ad-hoc fleet screen: run it twice around a suspect step
+        and diff."""
+        from ray_lightning_tpu.resilience.guard import build_sdc_probe
+
+        assert self.mesh is not None, "call setup() first"
+        return build_sdc_probe(params, self.mesh)
+
     # ---- compile-cache identity ------------------------------------------
 
     def compile_cache_key(self) -> str:

@@ -23,8 +23,16 @@ worker group (docs/RESILIENCE.md):
                     "hung" (silent channel).
   * faults.py     — deterministic fault injection (kill worker R at
                     step N, drop the coordinator, corrupt the latest
-                    checkpoint, ...) via RLT_FAULTS, so the whole
+                    checkpoint, poison a batch, flip a parameter bit on
+                    one chip, ...) via RLT_FAULTS, so the whole
                     subsystem is testable on CPU with launch_cpu_spmd.
+  * guard.py      — trainguard: in-step numerics guard compiled into
+                    the jitted train step (NaN/spike -> in-jit skip, no
+                    new host syncs), escalation to CORRUPTION rollbacks
+                    from the last blessed checkpoint, and a cadenced
+                    per-device parameter-fingerprint probe that catches
+                    silent data corruption and quarantines the
+                    divergent host.
 
 Surfaces: ``fit_distributed(..., resilience=ResilienceConfig(...))``,
 ``python -m ray_lightning_tpu supervise``, and sweep trial-level retry
@@ -55,6 +63,13 @@ from ray_lightning_tpu.resilience.faults import (
     corrupt_checkpoint,
     parse_faults,
 )
+from ray_lightning_tpu.resilience.guard import (
+    GuardCallback,
+    GuardConfig,
+    GuardState,
+    SDCDetectedError,
+    TrainingAnomalyError,
+)
 from ray_lightning_tpu.resilience.supervisor import (
     ResilienceConfig,
     RestartBudgetExceeded,
@@ -82,6 +97,11 @@ __all__ = [
     "FaultInjector",
     "corrupt_checkpoint",
     "parse_faults",
+    "GuardCallback",
+    "GuardConfig",
+    "GuardState",
+    "SDCDetectedError",
+    "TrainingAnomalyError",
     "ResilienceConfig",
     "RestartBudgetExceeded",
     "SupervisedFailure",

@@ -3,12 +3,23 @@ the resilience supervisor, from the command line.
 
 Two modes:
 
-  --smoke         the CI fault-injection gate (wired into format.sh): a
-                  supervised CPU-SPMD MNIST-class run with one injected
-                  worker kill. It must auto-resume from the step-cadence
-                  checkpoint and converge — exit 0 proves the whole
-                  kill -> classify -> relaunch -> resume path on a box
-                  with no accelerator.
+  --smoke         the CI fault-injection gate (wired into format.sh),
+                  three supervised CPU-SPMD legs on a box with no
+                  accelerator:
+                    kill      one injected worker kill must auto-resume
+                              from the step-cadence checkpoint and
+                              converge (kill -> classify -> relaunch ->
+                              resume, end to end);
+                    guard-nan an injected NaN batch must be SKIPPED
+                              in-jit by the trainguard (zero restarts —
+                              the process never dies) and still
+                              converge;
+                    guard-sdc an injected parameter bit-flip on rank 1
+                              must be caught by the SDC fingerprint
+                              probe within one cadence, rank 1
+                              quarantined, and the run must roll back
+                              to a blessed checkpoint and converge.
+                  ``--no-guard`` drops the two guard legs.
 
   <target>        ``pkg.mod:factory`` where factory() returns a dict with
                   module_factory / trainer_factory / data_factory — the
@@ -48,6 +59,9 @@ def _smoke_trainer():
         enable_progress_bar=False,
         enable_checkpointing=False,  # the supervisor adds its own cadence
         seed=0,
+        # every step's metrics are host-fetched: the guard legs' escalation
+        # check rides the fetch cadence, and a smoke run is tiny anyway
+        log_every_n_steps=1,
     )
 
 
@@ -81,8 +95,12 @@ def add_supervise_parser(sub) -> None:
                         "trainer_factory, data_factory}; omit with "
                         "--smoke")
     p.add_argument("--smoke", action="store_true",
-                   help="built-in CPU-SPMD convergence gate with one "
-                        "injected worker kill (the format.sh gate)")
+                   help="built-in CPU-SPMD convergence gate: an injected "
+                        "worker kill + the trainguard legs (injected NaN "
+                        "must skip in-jit; injected bit-flip must "
+                        "quarantine) — the format.sh gate")
+    p.add_argument("--no-guard", action="store_true",
+                   help="with --smoke: drop the two trainguard legs")
     p.add_argument("--processes", type=int, default=2)
     p.add_argument("--devices-per-process", type=int, default=1)
     p.add_argument("--platform", default="cpu",
@@ -126,16 +144,121 @@ def _load_target(spec: str):
     return job
 
 
+def _run_supervised_job(job, cfg, args, devices_per_process=None):
+    """One supervised fit under the CLI's knobs. Returns
+    ``(supervised_or_None, out_fields)``."""
+    from ray_lightning_tpu.resilience.supervisor import (
+        SupervisedFailure,
+        fit_supervised,
+    )
+
+    try:
+        supervised = fit_supervised(
+            job["module_factory"], job["trainer_factory"],
+            job["data_factory"], args.processes,
+            resilience=cfg,
+            platform=args.platform or None,
+            num_cpu_devices_per_process=(
+                (devices_per_process or args.devices_per_process)
+                if args.platform == "cpu" else None),
+            return_weights=False,
+            timeout=args.timeout,
+        )
+    except SupervisedFailure as exc:
+        return None, {"ok": False, "error": str(exc),
+                      "classified": exc.classified.to_dict()}
+    metrics = supervised.result.metrics
+    acc = metrics.get("ptl/val_accuracy")
+    return supervised, {
+        "ok": True,
+        "restarts": supervised.restarts,
+        "preemptions": supervised.preemptions,
+        "rollbacks": supervised.rollbacks,
+        "quarantined": supervised.quarantined,
+        "attempts": supervised.total_attempts,
+        "failures": supervised.failures,
+        "val_accuracy": (float(acc) if acc is not None else None),
+        "metrics": {k: v for k, v in metrics.items()
+                    if isinstance(v, (int, float))},
+    }
+
+
+def _smoke_guard_legs(args, base_dir) -> dict:
+    """The trainguard legs of the --smoke gate (ISSUE 5): an injected
+    NaN batch must be skipped IN-JIT (the process never dies: zero
+    restarts) and still converge; an injected parameter bit-flip must be
+    caught by the SDC probe, the rank quarantined, and the rolled-back
+    run must converge."""
+    import os
+
+    from ray_lightning_tpu.resilience.guard import GuardConfig
+    from ray_lightning_tpu.resilience.policy import RetryPolicy
+    from ray_lightning_tpu.resilience.supervisor import ResilienceConfig
+
+    job = {"module_factory": _smoke_module,
+           "trainer_factory": _smoke_trainer,
+           "data_factory": _smoke_data}
+
+    def _cfg(name, guard, faults):
+        return ResilienceConfig(
+            checkpoint_dir=os.path.join(base_dir, name),
+            policy=RetryPolicy(max_restarts=args.max_restarts,
+                               backoff_base_s=0.5, jitter=0.0),
+            save_every_n_steps=args.save_every,
+            stall_timeout_s=args.stall_timeout,
+            heartbeat_interval_s=1.0,
+            guard=guard, faults=faults)
+
+    legs: dict = {}
+
+    # leg 2: nan_loss -> in-jit skip, NO restart, converged
+    _, out = _run_supervised_job(
+        job, _cfg("guard_nan", GuardConfig(warmup_steps=2),
+                  "nan_loss:rank=0,step=3"), args)
+    skipped = (out.get("metrics") or {}).get("guard_skipped_steps", 0)
+    acc = out.get("val_accuracy")
+    ok = (out["ok"] and out.get("attempts") == 1 and skipped
+          and skipped >= 1 and acc is not None and acc > 0.8)
+    legs["guard_nan"] = {
+        "ok": bool(ok), "attempts": out.get("attempts"),
+        "guard_skipped_steps": skipped, "val_accuracy": acc}
+    if not ok:
+        legs["guard_nan"]["error"] = (
+            out.get("error")
+            or "injected NaN was not skipped in-jit without a restart "
+               f"(attempts={out.get('attempts')}, skipped={skipped}, "
+               f"acc={acc})")
+
+    # leg 3: bitflip_param on rank 1 -> SDC probe catches it within one
+    # cadence, rank 1 quarantined, rollback to a blessed ckpt, converged.
+    # 2 devices per process => 4 replicas: the flipped device is outvoted
+    # 3:1 and its host rank is attributable.
+    _, out = _run_supervised_job(
+        job, _cfg("guard_sdc", GuardConfig(sdc_every_n_steps=2),
+                  "bitflip_param:rank=1,step=3,device=0"), args,
+        devices_per_process=2)
+    acc = out.get("val_accuracy")
+    ok = (out["ok"] and out.get("rollbacks", 0) >= 1
+          and out.get("quarantined") == [1]
+          and acc is not None and acc > 0.8)
+    legs["guard_sdc"] = {
+        "ok": bool(ok), "rollbacks": out.get("rollbacks"),
+        "quarantined": out.get("quarantined"), "val_accuracy": acc}
+    if not ok:
+        legs["guard_sdc"]["error"] = (
+            out.get("error")
+            or "injected bit-flip was not caught+quarantined "
+               f"(rollbacks={out.get('rollbacks')}, "
+               f"quarantined={out.get('quarantined')}, acc={acc})")
+    return legs
+
+
 def run_supervise(args) -> int:
     import os
     import tempfile
 
     from ray_lightning_tpu.resilience.policy import RetryPolicy
-    from ray_lightning_tpu.resilience.supervisor import (
-        ResilienceConfig,
-        SupervisedFailure,
-        fit_supervised,
-    )
+    from ray_lightning_tpu.resilience.supervisor import ResilienceConfig
 
     if not args.smoke and not args.target:
         print("error: pass a pkg.mod:factory target or --smoke",
@@ -151,9 +274,10 @@ def run_supervise(args) -> int:
         job = _load_target(args.target)
         faults = args.faults
 
-    ckpt_dir = args.checkpoint_dir or (
+    ckpt_base = args.checkpoint_dir or (
         tempfile.mkdtemp(prefix="rlt_supervise_smoke_") if args.smoke
         else os.path.join(os.getcwd(), "rlt_logs", "supervise"))
+    ckpt_dir = os.path.join(ckpt_base, "kill") if args.smoke else ckpt_base
     cfg = ResilienceConfig(
         checkpoint_dir=ckpt_dir,
         policy=RetryPolicy(max_restarts=args.max_restarts,
@@ -163,48 +287,34 @@ def run_supervise(args) -> int:
         heartbeat_interval_s=1.0 if args.smoke else 5.0,
         faults=faults,
     )
-    out: dict = {"checkpoint_dir": ckpt_dir, "faults": faults}
-    try:
-        supervised = fit_supervised(
-            job["module_factory"], job["trainer_factory"],
-            job["data_factory"], args.processes,
-            resilience=cfg,
-            platform=args.platform or None,
-            num_cpu_devices_per_process=(
-                args.devices_per_process if args.platform == "cpu"
-                else None),
-            return_weights=False,
-            timeout=args.timeout,
-        )
-    except SupervisedFailure as exc:
-        out.update({"ok": False, "error": str(exc),
-                    "classified": exc.classified.to_dict()})
+    out: dict = {"checkpoint_dir": ckpt_base, "faults": faults}
+    supervised, fields = _run_supervised_job(job, cfg, args)
+    out.update(fields)
+    if supervised is None:
         print(json.dumps(out) if getattr(args, "as_json", False)
-              else f"supervise FAILED: {exc}",
+              else f"supervise FAILED: {out.get('error')}",
               file=None if getattr(args, "as_json", False) else sys.stderr)
         return 1
-    metrics = supervised.result.metrics
-    acc = metrics.get("ptl/val_accuracy")
-    out.update({
-        "ok": True,
-        "restarts": supervised.restarts,
-        "preemptions": supervised.preemptions,
-        "attempts": supervised.total_attempts,
-        "failures": supervised.failures,
-        "metrics": {k: v for k, v in metrics.items()
-                    if isinstance(v, (int, float))},
-    })
+    acc = out.get("val_accuracy")
     if args.smoke:
         # the gate's contract: the kill FIRED (otherwise the run proved
         # nothing) and the resumed run still converged
         recovered = supervised.total_attempts >= 2
-        converged = acc is not None and float(acc) > 0.8
+        converged = acc is not None and acc > 0.8
         out["ok"] = recovered and converged
         if not recovered:
             out["error"] = ("injected fault never fired — the smoke run "
                             "exercised nothing")
         elif not converged:
             out["error"] = f"resumed run did not converge (acc={acc})"
+        if not getattr(args, "no_guard", False):
+            legs = _smoke_guard_legs(args, ckpt_base)
+            out["guard_legs"] = legs
+            if not all(leg["ok"] for leg in legs.values()):
+                out["ok"] = False
+                out.setdefault("error", "; ".join(
+                    f"{name}: {leg.get('error')}"
+                    for name, leg in legs.items() if not leg["ok"]))
     if getattr(args, "as_json", False):
         print(json.dumps(out))
     else:
@@ -212,12 +322,17 @@ def run_supervise(args) -> int:
         print(f"supervise {status}: attempts={out['attempts']} "
               f"restarts={out['restarts']} "
               f"preemptions={out['preemptions']} "
-              + (f"val_accuracy={float(acc):.3f}" if acc is not None
+              f"rollbacks={out['rollbacks']} "
+              + (f"val_accuracy={acc:.3f}" if acc is not None
                  else ""))
         for f in supervised.failures:
             print(f"  attempt {f['attempt']}: [{f['kind']}/{f['cause']}"
                   + (f" rank {f['rank']}" if f.get("rank") is not None
                      else "") + f"] {f['detail']}")
+        for name, leg in (out.get("guard_legs") or {}).items():
+            print(f"  {name}: {'ok' if leg['ok'] else 'FAILED'} "
+                  + " ".join(f"{k}={v}" for k, v in leg.items()
+                             if k not in ("ok",)))
         if not out["ok"]:
             print(f"error: {out.get('error')}", file=sys.stderr)
     return 0 if out["ok"] else 1

@@ -16,6 +16,21 @@ A fault plan is a spec string (env ``RLT_FAULTS`` or
     corrupt_latest:rank=0,step=3,dir=/ckpts
                                   flip bytes in the newest checkpoint's
                                   state (latest_checkpoint must skip it)
+    nan_loss:rank=0,step=3,count=1
+                                  poison the batch about to become step
+                                  3 (NaN into its float leaves' local
+                                  shards) so the loss goes NaN for
+                                  ``count`` consecutive steps — the
+                                  trainguard must skip them in-jit
+    grad_blowup:rank=0,step=3,scale=1e18,count=1
+                                  scale the batch's float leaves so the
+                                  loss/grad blow up (spike/overflow)
+    bitflip_param:rank=1,step=3,bit=12,leaf=0,element=0,device=0
+                                  flip ONE mantissa bit of one param
+                                  element in ONE local device's replica
+                                  on the matching rank — a silent data
+                                  corruption only the trainguard's SDC
+                                  fingerprint probe can see
 
 ``rank=*`` matches every rank. Each fault fires ONCE per plan across
 restarts: a marker file is written under ``RLT_FAULT_STATE_DIR`` BEFORE
@@ -39,7 +54,15 @@ log = get_logger(__name__)
 FAULTS_ENV = "RLT_FAULTS"
 FAULT_STATE_ENV = "RLT_FAULT_STATE_DIR"
 
-_KINDS = ("kill", "preempt", "raise", "exit", "hang", "corrupt_latest")
+_KINDS = ("kill", "preempt", "raise", "exit", "hang", "corrupt_latest",
+          "nan_loss", "grad_blowup", "bitflip_param")
+
+#: kinds that poison the BATCH before the step dispatches (they ride the
+#: trainer's on_train_batch_start replacement seam); all other kinds
+#: fire at the batch-end boundary. ``step=k`` for these means "the batch
+#: that would become global step k" — so the anomaly lands exactly at
+#: step k, mirroring the batch-end kinds' step semantics.
+_BATCH_START_KINDS = ("nan_loss", "grad_blowup")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -119,14 +142,96 @@ def corrupt_checkpoint(path: str) -> bool:
     return True
 
 
+def _mutate_local_shards(arr, fn, only_device=None):
+    """Rebuild a (possibly multi-process) jax.Array from THIS process's
+    addressable shards with ``fn(numpy_copy) -> mutated?`` applied — to
+    every local shard, or to ``only_device``'s alone. Other processes
+    keep their original arrays untouched: the replicas genuinely
+    diverge, which is exactly what a hardware fault does. No collective
+    ops run (an SPMD-inconsistent computation on a global array would
+    deadlock the other ranks)."""
+    import jax
+    import numpy as np
+
+    shards = getattr(arr, "addressable_shards", None)
+    if not shards:
+        return arr
+    bufs, changed = [], False
+    for s in shards:
+        data = np.array(s.data)  # host copy
+        if (only_device is None or s.device == only_device) and fn(data):
+            changed = True
+        bufs.append(jax.device_put(data, s.device))
+    if not changed:
+        return arr
+    return jax.make_array_from_single_device_arrays(
+        arr.shape, arr.sharding, bufs)
+
+
+def _poison_batch(batch, kind: str, scale: float):
+    """nan_loss / grad_blowup batch poisoning: every float leaf's local
+    shards get a NaN in element 0 (nan_loss) or a blow-up scale
+    (grad_blowup). The loss is a global reduction, so a single poisoned
+    rank poisons the step identically on every rank — the skip decision
+    the guard compiles in stays SPMD-consistent."""
+    import jax
+    import numpy as np
+
+    def mutate(data):
+        if not np.issubdtype(data.dtype, np.floating) or data.size == 0:
+            return False
+        if kind == "nan_loss":
+            data.reshape(-1)[0] = np.nan
+        else:
+            np.multiply(data, data.dtype.type(scale), out=data)
+        return True
+
+    return jax.tree.map(lambda x: _mutate_local_shards(x, mutate), batch)
+
+
+def _bitflip_param_tree(params, leaf_idx: int, element: int, bit: int,
+                        device):
+    """Flip one mantissa bit of one element in ONE device's local
+    replica of the ``leaf_idx``-th float param leaf. Returns the new
+    tree (shared-structure except the flipped leaf)."""
+    import jax
+    import numpy as np
+
+    flat, treedef = jax.tree_util.tree_flatten(params)
+    float_positions = [i for i, x in enumerate(flat)
+                       if np.issubdtype(x.dtype, np.floating)]
+    if not float_positions:
+        return params
+    pos = float_positions[leaf_idx % len(float_positions)]
+
+    def flip(data):
+        itemsize = data.dtype.itemsize
+        view_dtype = {2: np.uint16, 4: np.uint32, 8: np.uint64}.get(
+            itemsize)
+        if view_dtype is None or data.size == 0:
+            return False
+        view = data.view(view_dtype).reshape(-1)
+        view[element % view.size] ^= view_dtype(1 << (bit % (8 * itemsize)))
+        return True
+
+    flat[pos] = _mutate_local_shards(flat[pos], flip, only_device=device)
+    return jax.tree_util.tree_unflatten(treedef, flat)
+
+
 class FaultInjector(Callback):
-    """Fires plan faults at batch boundaries on the matching rank."""
+    """Fires plan faults at batch boundaries on the matching rank (the
+    batch-poisoning kinds fire at batch START, through the trainer's
+    batch replacement seam)."""
 
     def __init__(self, faults: List[Fault],
                  state_dir: Optional[str] = None):
         self.faults = faults
         self.state_dir = state_dir
         self._fired_local: set = set()
+        #: remaining poison budget per fired batch-start fault (the
+        #: ``count=N`` arg poisons N consecutive batches within the run
+        #: that fired it; the once-marker still spans restarts)
+        self._active: Dict[str, int] = {}
 
     # -- once-ness ---------------------------------------------------------
     def _already_fired(self, fault: Fault, rank: int) -> bool:
@@ -172,6 +277,8 @@ class FaultInjector(Callback):
                 f"(fault plan #{fault.index})")
         elif fault.kind == "hang":
             time.sleep(float(fault.args.get("secs", "600")))
+        elif fault.kind == "bitflip_param":
+            self._fire_bitflip(fault, trainer)
         elif fault.kind == "corrupt_latest":
             target = fault.args.get("dir")
             if not target:
@@ -180,9 +287,59 @@ class FaultInjector(Callback):
             if newest is not None:
                 corrupt_checkpoint(newest)
 
+    def _fire_bitflip(self, fault: Fault, trainer) -> None:
+        """Silent data corruption: one mantissa bit of one param element
+        flips in ONE local device's replica — invisible to every check
+        except a cross-replica fingerprint comparison."""
+        import jax
+
+        state = getattr(trainer, "state", None)
+        if state is None or state.params is None:
+            return
+        local = jax.local_devices()
+        device = local[int(fault.args.get("device", "0")) % len(local)]
+        params = _bitflip_param_tree(
+            state.params,
+            leaf_idx=int(fault.args.get("leaf", "0")),
+            element=int(fault.args.get("element", "0")),
+            bit=int(fault.args.get("bit", "12")),
+            device=device)
+        trainer.state = state.replace(params=params)
+
+    def on_train_batch_start(self, trainer, module, batch, batch_idx):
+        rank = self._rank()
+        out = batch
+        for fault in self.faults:
+            if fault.kind not in _BATCH_START_KINDS:
+                continue
+            key = fault.marker(rank)
+            remaining = self._active.get(key)
+            if remaining is None:
+                # step=k poisons the batch that becomes global step k
+                if not fault.matches(rank, trainer.global_step + 1):
+                    continue
+                if self._already_fired(fault, rank):
+                    continue
+                self._mark_fired(fault, rank)
+                remaining = int(fault.args.get("count", "1"))
+            if remaining <= 0:
+                continue
+            self._active[key] = remaining - 1
+            log.warning(
+                "fault injection: poisoning batch for %s (rank=%s "
+                "step>=%d, %d more) at global_step=%d", fault.kind,
+                fault.rank, fault.step, remaining - 1,
+                trainer.global_step)
+            out = _poison_batch(
+                out, fault.kind,
+                scale=float(fault.args.get("scale", "1e18")))
+        return out if out is not batch else None
+
     def on_train_batch_end(self, trainer, module, metrics, batch_idx) -> None:
         rank = self._rank()
         for fault in self.faults:
+            if fault.kind in _BATCH_START_KINDS:
+                continue
             if not fault.matches(rank, trainer.global_step):
                 continue
             if self._already_fired(fault, rank):

@@ -1,7 +1,7 @@
 """Failure taxonomy + retry policy — the supervisor's decision core.
 
 Classification answers ONE question: is restarting the worker group and
-resuming from the latest valid checkpoint going to help? Three answers:
+resuming from the latest valid checkpoint going to help? Four answers:
 
   RETRYABLE  — infrastructure flaked (backend unavailable, a worker
                process vanished with a nonzero rc, a stall/timeout, a
@@ -12,10 +12,18 @@ resuming from the latest valid checkpoint going to help? Three answers:
                counted separately: a preemption storm is capacity
                pressure, not a bug, and operators read the two numbers
                differently.
+  CORRUPTION — the trainguard (resilience/guard.py) escalated: a run of
+               anomalous steps (NaN/spike streak) or a silent-data-
+               corruption verdict from the replica fingerprint probe.
+               Restartable, but NOT from the latest checkpoint — the
+               supervisor rolls back to the last *blessed* checkpoint,
+               advances the data order past the poisoned window, and
+               quarantines the divergent rank if one was named. Drawn
+               from its own (small) ``max_rollbacks`` budget.
   FATAL      — a deterministic Python exception in user/model code (a
-               shape error, a NaN guard, an assert). Restarting replays
-               the same failure N more times and burns the budget;
-               fail fast with the classified cause.
+               shape error, an assert). Restarting replays the same
+               failure N more times and burns the budget; fail fast
+               with the classified cause.
 
 This module is import-light BY DESIGN (stdlib only, no jax, no package
 imports): bench.py classifies mid-run backend losses with it before any
@@ -33,6 +41,7 @@ from typing import Optional
 class FailureKind:
     RETRYABLE = "retryable"
     PREEMPTION = "preemption"
+    CORRUPTION = "corruption"
     FATAL = "fatal"
 
 
@@ -73,6 +82,21 @@ _RETRYABLE_MARKERS = (
 _PREEMPT_MARKERS = ("PreemptedError", "preemption notice")
 
 _PREEMPT_SIGNALS = ("SIGTERM", "SIGINT", "SIGHUP", "SIGQUIT")
+
+#: trainguard escalation markers (resilience/guard.py): the exception
+#: NAMES are the cross-process protocol — they appear verbatim in the
+#: worker traceback when a rank unwinds on an anomaly-streak or SDC
+#: verdict. SDCDetectedError subclasses TrainingAnomalyError, so order
+#: matters: match the more specific name first for the cause slug.
+_CORRUPTION_MARKERS = ("SDCDetectedError", "TrainingAnomalyError",
+                       "silent data corruption",
+                       "training anomaly escalation")
+
+
+def _corruption_cause(text: str) -> str:
+    return "sdc" if ("SDCDetectedError" in text
+                     or "silent data corruption" in text) else \
+        "anomaly-streak"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -135,6 +159,13 @@ def classify_failure(exc: BaseException) -> FailureClass:
                     else f"worker-exit:{getattr(exc, 'exit_code', None)}")
             return FailureClass(FailureKind.RETRYABLE, slug, rank,
                                 _worker_detail(exc))
+        if any(m in tb for m in _CORRUPTION_MARKERS):
+            # the trainguard unwound this rank on purpose: restart is a
+            # ROLLBACK (blessed checkpoint + data-order advance), not a
+            # replay of the latest one
+            return FailureClass(FailureKind.CORRUPTION,
+                                _corruption_cause(tb), rank,
+                                _worker_detail(exc))
         if any(m in tb for m in _RETRYABLE_MARKERS):
             return FailureClass(FailureKind.RETRYABLE, "worker-backend",
                                 rank, _worker_detail(exc))
@@ -142,6 +173,11 @@ def classify_failure(exc: BaseException) -> FailureClass:
         return FailureClass(FailureKind.FATAL, "worker-exception", rank,
                             _worker_detail(exc))
 
+    if name in ("TrainingAnomalyError", "SDCDetectedError") or any(
+            m in text for m in _CORRUPTION_MARKERS):
+        return FailureClass(FailureKind.CORRUPTION,
+                            _corruption_cause(f"{name} {text}"), None,
+                            _first_line(exc))
     if isinstance(exc, StallError):
         return FailureClass(FailureKind.RETRYABLE, "stall",
                             getattr(exc, "rank", None), _first_line(exc))
@@ -185,6 +221,12 @@ class RetryPolicy:
     jitter: float = 0.1          # +- fraction of the delay
     preemptions_count: bool = False
     max_preemptions: int = 100
+    #: CORRUPTION rollbacks (trainguard escalations) get their own small
+    #: budget: each one rewinds real progress to the last blessed
+    #: checkpoint, so unlike preemptions they must stay rare — and a run
+    #: that keeps corrupting is hardware begging to be drained, not
+    #: restarted forever.
+    max_rollbacks: int = 2
 
     def next_delay(self, restart_idx: int) -> float:
         """Delay before restart number ``restart_idx`` (1-based)."""
@@ -196,12 +238,14 @@ class RetryPolicy:
         return max(0.0, delay)
 
     def allows(self, restarts: int, preemptions: int,
-               failure: FailureClass) -> bool:
+               failure: FailureClass, rollbacks: int = 0) -> bool:
         """True when one more restart is within budget for ``failure``.
-        ``restarts``/``preemptions`` are the counts performed so far,
-        tracked separately by the supervisor."""
+        ``restarts``/``preemptions``/``rollbacks`` are the counts
+        performed so far, tracked separately by the supervisor."""
         if not failure.restartable:
             return False
+        if failure.kind == FailureKind.CORRUPTION:
+            return rollbacks < self.max_rollbacks
         if failure.kind == FailureKind.PREEMPTION:
             if self.preemptions_count:
                 # preemptions draw from the shared budget: count BOTH

@@ -75,6 +75,14 @@ class ResilienceConfig:
     #: compile otherwise multiplies by the restart budget). None derives
     #: ``<checkpoint_dir>/.compile_cache``; "off" disables.
     compile_cache_dir: Optional[str] = None
+    #: trainguard (resilience/guard.py): a GuardConfig (or True for the
+    #: defaults) compiles the in-step anomaly guard into every worker's
+    #: train step and arms escalation + the SDC probe. A CORRUPTION
+    #: escalation makes the supervisor ROLL BACK: resume from the last
+    #: blessed checkpoint at/below the marker's last-good step, advance
+    #: the data order past the poisoned window, and record any
+    #: quarantined rank in <checkpoint_dir>/.quarantine.json.
+    guard: Any = None
 
     def resolved_compile_cache_dir(self) -> Optional[str]:
         if self.compile_cache_dir == "off":
@@ -91,10 +99,13 @@ class SupervisedResult:
     restarts: int                   # retryable restarts performed
     preemptions: int                # preemption resumes performed
     failures: List[Dict[str, Any]]  # classified history, launch order
+    rollbacks: int = 0              # trainguard corruption rollbacks
+    quarantined: List[int] = dataclasses.field(default_factory=list)
+    #                                 ranks the SDC probe attributed
 
     @property
     def total_attempts(self) -> int:
-        return 1 + self.restarts + self.preemptions
+        return 1 + self.restarts + self.preemptions + self.rollbacks
 
 
 class SupervisedFailure(RuntimeError):
@@ -173,6 +184,23 @@ def _wrapped_trainer_factory(trainer_factory: Callable[[], Any],
     trainer.callbacks.append(PreemptionGuard(
         cfg.checkpoint_dir, grace_s=cfg.preempt_grace_s,
         signals=(_signal.SIGTERM,)))
+    if cfg.guard:
+        from ray_lightning_tpu.resilience.guard import (
+            GuardCallback,
+            GuardConfig,
+            read_rollback_marker,
+        )
+
+        trainer.guard = GuardConfig.coerce(cfg.guard)
+        if not any(isinstance(c, GuardCallback) for c in trainer.callbacks):
+            trainer.callbacks.append(GuardCallback(
+                trainer.guard, marker_dir=cfg.checkpoint_dir))
+        marker = read_rollback_marker(cfg.checkpoint_dir)
+        if marker:
+            # after a corruption rollback: advance the data order past
+            # the poisoned window (trainer._apply_rollback_skip; stale
+            # markers from older incidents no-op there)
+            trainer.resume_skip_past = marker
     faults = parse_faults(cfg.faults) if cfg.faults else faults_from_env()
     if faults:
         state_dir = (cfg.fault_state_dir
@@ -243,11 +271,13 @@ def supervise(
 
     restarts = 0
     preemptions = 0
+    rollbacks = 0
+    quarantined: List[int] = []
     failures: List[Dict[str, Any]] = []
     while True:
         if monitor is not None:
             monitor.reset()
-        attempts = 1 + restarts + preemptions
+        attempts = 1 + restarts + preemptions + rollbacks
         try:
             result = run_distributed(
                 kind, module_factory, wrapped_tf, data_factory,
@@ -259,7 +289,8 @@ def supervise(
                           else None),
                 **kw,
             )
-            return SupervisedResult(result, restarts, preemptions, failures)
+            return SupervisedResult(result, restarts, preemptions,
+                                    failures, rollbacks, quarantined)
         except BaseException as exc:
             if isinstance(exc, (KeyboardInterrupt, SystemExit)):
                 raise
@@ -270,22 +301,121 @@ def supervise(
                         attempts, fc.kind, fc.cause, fc.detail)
             if fc.kind == FailureKind.FATAL:
                 raise SupervisedFailure(fc, attempts) from exc
-            if not policy.allows(restarts, preemptions, fc):
+            if not policy.allows(restarts, preemptions, fc, rollbacks):
                 raise RestartBudgetExceeded(
-                    fc, attempts, policy.max_restarts) from exc
+                    fc, attempts,
+                    policy.max_rollbacks
+                    if fc.kind == FailureKind.CORRUPTION
+                    else policy.max_restarts) from exc
             if fc.kind == FailureKind.PREEMPTION:
                 preemptions += 1
+            elif fc.kind == FailureKind.CORRUPTION:
+                rollbacks += 1
             else:
                 restarts += 1
-            delay = policy.next_delay(restarts + preemptions)
-            if kind == "fit":
+            delay = policy.next_delay(restarts + preemptions + rollbacks)
+            if fc.kind == FailureKind.CORRUPTION and kind == "fit":
+                ckpt_path = _rollback_target(cfg, rollbacks, quarantined,
+                                             original_ckpt)
+            elif kind == "fit":
                 found = latest_checkpoint(cfg.checkpoint_dir)
                 ckpt_path = found if found is not None else original_ckpt
             log.warning(
-                "supervise: restart %d (retryable %d, preemptions %d) in "
-                "%.1fs, resuming from %s", restarts + preemptions,
-                restarts, preemptions, delay, ckpt_path or "scratch")
+                "supervise: restart %d (retryable %d, preemptions %d, "
+                "rollbacks %d) in %.1fs, resuming from %s",
+                restarts + preemptions + rollbacks, restarts,
+                preemptions, rollbacks, delay, ckpt_path or "scratch")
             time.sleep(delay)
+
+
+def _rollback_target(cfg: ResilienceConfig, rollbacks: int,
+                     quarantined: List[int],
+                     original_ckpt: Optional[str]) -> Optional[str]:
+    """Pick the resume source after a trainguard CORRUPTION escalation:
+    the newest BLESSED checkpoint at/below the marker's last-good step
+    (a blessed-but-newer one could already carry the silent corruption
+    the probe only just caught). Also folds the marker's quarantine
+    verdict into the ledger and the on-disk ``.quarantine.json`` the
+    next scheduler/operator reads, and stamps the rollback count back
+    into the marker so the relaunched workers can surface it as the
+    ``guard_rollbacks`` metric."""
+    import json
+
+    from ray_lightning_tpu.resilience.guard import (
+        QUARANTINE_FILE,
+        read_rollback_marker,
+        write_rollback_marker,
+    )
+
+    marker = read_rollback_marker(cfg.checkpoint_dir) or {}
+    max_step = marker.get("last_good_step")
+    for rank in marker.get("quarantine") or []:
+        if rank not in quarantined:
+            quarantined.append(rank)
+    if quarantined:
+        qpath = os.path.join(cfg.checkpoint_dir, QUARANTINE_FILE)
+        tmp = qpath + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"excluded": sorted(quarantined),
+                       "at": time.time()}, f)
+        os.replace(tmp, qpath)
+        log.error("supervise: quarantining rank(s) %s (divergent "
+                  "parameter fingerprint) — recorded in %s",
+                  sorted(quarantined), qpath)
+    if marker:
+        write_rollback_marker(cfg.checkpoint_dir,
+                              {**marker, "rollbacks_performed": rollbacks})
+    if max_step is not None:
+        # Abandon the poisoned window FOR GOOD: every checkpoint newer
+        # than the last known-good step moves into quarantined.ckpts/
+        # (kept for forensics, out of every candidate set). Without
+        # this, a later RETRYABLE/PREEMPTION restart — or a driver
+        # relaunch with resume="auto" — would pick the newest
+        # blessed-but-silently-poisoned checkpoint right back up. Safe
+        # to move here: the worker group is already torn down.
+        _quarantine_newer_checkpoints(cfg.checkpoint_dir, int(max_step))
+    found = latest_checkpoint(
+        cfg.checkpoint_dir, good_only=True,
+        max_step=int(max_step) if max_step is not None else None)
+    if found is None:
+        log.warning("supervise: no blessed checkpoint at/below step %s — "
+                    "rolling back to %s", max_step,
+                    original_ckpt or "scratch")
+    return found if found is not None else original_ckpt
+
+
+def _quarantine_newer_checkpoints(directory: str, max_step: int) -> None:
+    """Move checkpoint subdirs with a recorded global_step above the
+    rollback horizon into ``<directory>/quarantined.ckpts/`` — one
+    level down, so ``latest_checkpoint`` (which scans immediate
+    subdirs) never sees them again."""
+    import json
+
+    dest_root = os.path.join(directory, "quarantined.ckpts")
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return
+    for name in names:
+        cand = os.path.join(directory, name)
+        meta_path = os.path.join(cand, "meta.json")
+        if not os.path.isdir(os.path.join(cand, "state")):
+            continue
+        try:
+            with open(meta_path) as f:
+                step = int(json.load(f).get("global_step", -1))
+        except (OSError, ValueError, TypeError):
+            continue  # unreadable: verify_checkpoint already rejects it
+        if step <= max_step:
+            continue
+        os.makedirs(dest_root, exist_ok=True)
+        try:
+            os.rename(cand, os.path.join(
+                dest_root, f"{name}.rb{int(time.time())}"))
+            log.warning("supervise: quarantined poisoned checkpoint %s "
+                        "(step %d > last good %d)", cand, step, max_step)
+        except OSError:
+            log.exception("could not quarantine checkpoint %s", cand)
 
 
 def fit_supervised(

@@ -181,14 +181,34 @@ class TuneReportCheckpointCallback(TuneReportCallback):
         Only rank 0 removes files (a sharded write is collective, but the
         dirs live on a shared filesystem); only paths THIS callback wrote
         are ever touched. _written mutates identically on every rank so
-        the bookkeeping stays in step."""
+        the bookkeeping stays in step.
+
+        Retention floor (trainguard): when every checkpoint inside the
+        keep window is explicitly UNblessed (written during an anomaly
+        streak), the newest blessed one outside it is exempted — the
+        trial's rollback restore point must survive the window sliding
+        past it."""
         import jax
 
-        from ray_lightning_tpu.core.callbacks import _remove_checkpoint
+        from ray_lightning_tpu.core.callbacks import (
+            _ckpt_blessed,
+            _remove_checkpoint,
+        )
 
-        while len(self._written) > self.keep_last_n:
-            victim = self._written.pop(0)
-            if jax.process_index() != 0:
+        excess = len(self._written) - self.keep_last_n
+        if excess <= 0:
+            return
+        victims, kept = self._written[:excess], self._written[excess:]
+        protected = None
+        if not any(_ckpt_blessed(p) is True for p in kept):
+            for p in reversed(victims):  # newest blessed victim
+                if _ckpt_blessed(p) is True:
+                    protected = p
+                    break
+        for victim in victims:
+            if victim == protected:
                 continue
-            _remove_checkpoint(victim)
-            log.info("pruned sweep checkpoint %s", victim)
+            if jax.process_index() == 0:
+                _remove_checkpoint(victim)
+                log.info("pruned sweep checkpoint %s", victim)
+        self._written = ([protected] if protected else []) + kept

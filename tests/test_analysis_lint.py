@@ -492,3 +492,93 @@ def test_rlt304_nested_hot_loops_report_once():
         "            x = float(m)\n")
     assert rules_of(fs) == ["RLT304"]
     assert len(fs) == 1, [f.format() for f in fs]
+
+
+# ---- RLT402 nan-through-where (trainguard, ISSUE 5) ------------------------
+
+
+def test_rlt402_where_with_risky_branch():
+    fs = lint(
+        "import jax.numpy as jnp\n"
+        "class M:\n"
+        "    def training_step(self, params, batch, rng):\n"
+        "        x = batch['x']\n"
+        "        return jnp.where(x > 0, jnp.log(x), 0.0).sum()\n")
+    assert "RLT402" in rules_of(fs)
+
+
+def test_rlt402_division_and_power_in_branch():
+    fs = lint(
+        "import jax.numpy as jnp\n"
+        "class M:\n"
+        "    def training_step(self, params, batch, rng):\n"
+        "        r = jnp.where(m, a / b, 0.0)\n"
+        "        p = jnp.where(m, x ** 0.5, 1.0)\n"
+        "        return r + p\n")
+    assert [f.rule for f in fs] == ["RLT402", "RLT402"]
+
+
+def test_rlt402_safe_division_and_power_are_clean():
+    # the sanctioned clamp on the denominator, and integer powers
+    # (finite gradient everywhere), must not be flagged
+    fs = lint(
+        "import jax.numpy as jnp\n"
+        "class M:\n"
+        "    def training_step(self, params, batch, rng):\n"
+        "        r = jnp.where(m, x / jnp.maximum(d, 1e-6), 0.0)\n"
+        "        q = jnp.where(m, x ** 2, 0.0)\n"
+        "        s = jnp.where(m, jnp.maximum(x, 0.0) ** 0.5, 0.0)\n"
+        "        return r + q + s\n")
+    assert "RLT402" not in rules_of(fs)
+
+
+def test_rlt402_masked_input_is_clean():
+    # the FIX the rule recommends must not itself be flagged
+    fs = lint(
+        "import jax.numpy as jnp\n"
+        "class M:\n"
+        "    def training_step(self, params, batch, rng):\n"
+        "        x = batch['x']\n"
+        "        safe = jnp.log(jnp.where(x > 0, x, 1.0))\n"
+        "        clamped = jnp.where(x > 0, jnp.log(jnp.maximum(x, 1e-6)),\n"
+        "                            0.0)\n"
+        "        return (safe + clamped).sum()\n")
+    assert "RLT402" not in rules_of(fs)
+
+
+def test_rlt402_unguarded_log_of_batch():
+    fs = lint(
+        "import jax.numpy as jnp\n"
+        "class M:\n"
+        "    def training_step(self, params, batch, rng):\n"
+        "        return jnp.log(batch['x']).sum()\n")
+    assert "RLT402" in rules_of(fs)
+    # epsilon-shifted input is the sanctioned pattern
+    fs = lint(
+        "import jax.numpy as jnp\n"
+        "class M:\n"
+        "    def training_step(self, params, batch, rng):\n"
+        "        return jnp.log(batch['x'] + 1e-6).sum()\n")
+    assert "RLT402" not in rules_of(fs)
+
+
+def test_rlt402_only_in_traced_code():
+    # host-side code may where/log freely — the cotangent trap is a
+    # property of differentiated traced code
+    fs = lint(
+        "import jax.numpy as jnp\n"
+        "def report(batch):\n"
+        "    return jnp.where(batch > 0, jnp.log(batch), 0.0)\n")
+    assert "RLT402" not in rules_of(fs)
+
+
+def test_rlt402_suppressible():
+    fs = lint(
+        "import jax.numpy as jnp\n"
+        "class M:\n"
+        "    def training_step(self, params, batch, rng):\n"
+        "        x = batch['x']\n"
+        "        y = jnp.where(x > 0, jnp.log(x), 0.0)"
+        "  # rlt: disable=RLT402\n"
+        "        return y.sum()\n")
+    assert "RLT402" not in rules_of(fs)

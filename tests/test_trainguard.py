@@ -1,0 +1,677 @@
+"""trainguard (ISSUE 5): in-step numerics guard, SDC detection, and
+rollback-to-last-good (docs/RESILIENCE.md "trainguard").
+
+Fast tests prove the acceptance matrix at Trainer level on the virtual
+CPU mesh: an injected NaN batch is skipped IN-JIT and the final params
+are bitwise-identical to a clean run trained without that batch; the
+guard adds no per-step host syncs (the guarded step's jaxpr carries no
+effects and the anomaly flag rides the metrics outputs; RLT201/RLT304
+lint the trainer+guard clean); K anomalies escalate with a rollback
+marker; the SDC fingerprint probe catches a one-bit parameter flip and
+attributes the divergent device; blessed-checkpoint retention and
+selection. The @slow tests drive REAL 2-process SPMD groups through
+supervise(): nan-skip with zero restarts, corruption rollback resuming
+from the blessed checkpoint with the data order advanced past the
+poisoned window, and bit-flip -> quarantine.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.resilience.guard import (
+    GuardConfig,
+    SDCDetectedError,
+    TrainingAnomalyError,
+    diagnose_digests,
+    read_rollback_marker,
+)
+from ray_lightning_tpu.resilience.policy import (
+    FailureKind,
+    RetryPolicy,
+    classify_failure,
+)
+
+# ------------------------------------------------------------- helpers
+
+
+class SkipLoader:
+    """Deterministic loader wrapper that drops selected (epoch, batch)
+    pairs — the "clean run trained without that batch" reference."""
+
+    def __init__(self, loader, skip):
+        self.loader = loader
+        self.skip = set(skip)
+        self._epoch = 0
+
+    def set_epoch(self, epoch):
+        self._epoch = epoch
+        if hasattr(self.loader, "set_epoch"):
+            self.loader.set_epoch(epoch)
+
+    def __iter__(self):
+        for i, b in enumerate(iter(self.loader)):
+            if (self._epoch, i) in self.skip:
+                continue
+            yield b
+
+
+def _loader(batch_size=32, seed=5):
+    from ray_lightning_tpu import DataLoader
+    from tests.utils import random_dataset
+
+    return DataLoader(random_dataset(), batch_size=batch_size,
+                      shuffle=True, seed=seed)
+
+
+def _trainer(tmp_path, guard=None, strategy=None, callbacks=None, **kw):
+    from ray_lightning_tpu import SingleDevice, Trainer
+
+    return Trainer(strategy=strategy or SingleDevice(), max_epochs=2,
+                   enable_checkpointing=False, enable_progress_bar=False,
+                   seed=7, log_every_n_steps=1,
+                   default_root_dir=str(tmp_path), guard=guard,
+                   callbacks=callbacks, **kw)
+
+
+# --------------------------------------------------- tier 1: in-jit skip
+
+
+def test_nan_skip_bitwise_equals_clean_minus_batch(tmp_path):
+    """The acceptance bar: nan_loss injected at step 3 is skipped in-jit
+    and the final params are BITWISE identical to a clean run trained
+    without that batch — the discarded update also leaves the step index
+    (per-step RNG fold, optimizer schedule) untouched."""
+    import jax
+
+    from ray_lightning_tpu.resilience.faults import Fault, FaultInjector
+    from tests.utils import BoringModel
+
+    clean = BoringModel()
+    # batch idx 2 of epoch 0 is the one that would have become step 3
+    _trainer(tmp_path / "a").fit(clean, SkipLoader(_loader(), {(0, 2)}))
+
+    hurt = BoringModel()
+    t = _trainer(tmp_path / "b", guard=GuardConfig(warmup_steps=2))
+    t.callbacks.append(
+        FaultInjector([Fault("nan_loss", None, 3, {}, index=0)]))
+    t.fit(hurt, _loader())
+
+    assert t.callback_metrics["guard_skipped_steps"] == 1
+    assert t.callback_metrics["guard_last_anomaly"] == 2  # update index
+    assert int(jax.device_get(t.state.step)) == 15  # 16 batches, 1 skip
+    for a, b in zip(jax.tree.leaves(jax.device_get(clean.params)),
+                    jax.tree.leaves(jax.device_get(hurt.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_grad_blowup_is_skipped_and_training_recovers(tmp_path):
+    from ray_lightning_tpu.resilience.faults import Fault, FaultInjector
+    from tests.utils import BoringModel
+
+    t = _trainer(tmp_path, guard=GuardConfig(warmup_steps=2))
+    t.callbacks.append(FaultInjector(
+        [Fault("grad_blowup", None, 4, {"scale": "1e18"}, index=0)]))
+    t.fit(BoringModel(), _loader())
+    assert t.callback_metrics["guard_skipped_steps"] >= 1
+    # the skipped update left the params usable: the run kept training
+    assert np.isfinite(t.callback_metrics["loss"])
+    assert t.callback_metrics["guard_streak"] == 0
+
+
+def test_guard_disabled_changes_nothing(tmp_path):
+    """guard=None trains bitwise-identically to the pre-guard trainer
+    (the empty-tuple guard slot contributes no pytree leaves)."""
+    import jax
+
+    from tests.utils import BoringModel
+
+    a, b = BoringModel(), BoringModel()
+    _trainer(tmp_path / "a").fit(a, _loader())
+    t = _trainer(tmp_path / "b", guard=GuardConfig())
+    t.fit(b, _loader())
+    assert "guard_skipped_steps" in t.callback_metrics
+    for x, y in zip(jax.tree.leaves(jax.device_get(a.params)),
+                    jax.tree.leaves(jax.device_get(b.params))):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_guard_step_adds_no_host_syncs(tmp_path):
+    """The RLT304 acceptance criterion, pinned two ways: (1) the guarded
+    train step's jaxpr carries NO effects (no callbacks, no transfers —
+    the anomaly flag rides the metrics outputs the trainer already
+    fetches lazily); (2) the trainer + guard source lint clean under the
+    host-sync rules."""
+    import jax
+
+    from ray_lightning_tpu.analysis import lint_paths
+    from tests.utils import BoringModel
+
+    t = _trainer(tmp_path, guard=GuardConfig(), max_steps=1,
+                 limit_train_batches=1)
+    t.fit(BoringModel(), _loader())
+    batch = t._cast(next(iter(_loader())))
+    device_batch = t._shard_train_batch(batch)
+    jaxpr = jax.make_jaxpr(
+        lambda s, b, r: t._train_step._jitted(s, b, r))(
+            t.state, device_batch, t._base_rng)
+    assert not jaxpr.effects, f"guarded step has effects: {jaxpr.effects}"
+    _, metrics = jax.eval_shape(
+        lambda s, b, r: t._train_step._jitted(s, b, r),
+        t.state, device_batch, t._base_rng)
+    for counter in ("guard_anomaly", "guard_skipped_steps",
+                    "guard_streak", "guard_last_anomaly"):
+        assert counter in metrics  # the flag RIDES the metrics outputs
+
+    import ray_lightning_tpu.core.trainer as trainer_mod
+    import ray_lightning_tpu.resilience.guard as guard_mod
+
+    findings = lint_paths([trainer_mod.__file__, guard_mod.__file__])
+    host_sync = [f for f in findings if f.rule in ("RLT201", "RLT304")]
+    assert not host_sync, [f.format() for f in host_sync]
+
+
+# ------------------------------------------- tier 2: escalation/rollback
+
+
+def _sticky_nan_fit(tmp_path, callbacks=None, **guard_kw):
+    from ray_lightning_tpu.resilience.faults import Fault, FaultInjector
+    from tests.utils import BoringModel
+
+    guard_kw.setdefault("warmup_steps", 1)
+    guard_kw.setdefault("escalate_after", 3)
+    guard_kw.setdefault("escalate_window", 8)
+    t = _trainer(tmp_path, guard=GuardConfig(**guard_kw),
+                 callbacks=list(callbacks or []))
+    t.callbacks.append(FaultInjector(
+        [Fault("nan_loss", None, 4, {"count": "10"}, index=0)]))
+    with pytest.raises(TrainingAnomalyError) as exc_info:
+        t.fit(BoringModel(), _loader())
+    return t, exc_info.value
+
+
+def test_escalation_raises_and_writes_marker(tmp_path):
+    t, err = _sticky_nan_fit(tmp_path)
+    assert err.detected_step == 6          # anomalies at steps 4, 5, 6
+    assert err.last_good_step == 3
+    marker = read_rollback_marker(str(tmp_path))
+    assert marker["kind"] == "anomaly-streak"
+    assert marker["last_good_step"] == 3
+    assert marker["epoch"] == 0 and marker["epoch_batch"] == 6
+    fc = classify_failure(err)
+    assert fc.kind == FailureKind.CORRUPTION
+    assert fc.cause == "anomaly-streak" and fc.restartable
+
+
+def test_classify_corruption_from_worker_traceback():
+    """The exception NAME travels inside the worker traceback — the
+    driver-side classification keys on it (CORRUPTION, never FATAL)."""
+    from ray_lightning_tpu.runtime.group import WorkerError
+
+    err = WorkerError(1, "Traceback ...\nray_lightning_tpu.resilience."
+                         "guard.TrainingAnomalyError: training anomaly "
+                         "escalation: 3 anomalous step(s) ...")
+    fc = classify_failure(err)
+    assert fc.kind == FailureKind.CORRUPTION
+    assert fc.cause == "anomaly-streak" and fc.rank == 1
+    sdc = WorkerError(0, "Traceback ...\nray_lightning_tpu.resilience."
+                         "guard.SDCDetectedError: silent data corruption "
+                         "detected at step 4 ...")
+    assert classify_failure(sdc).cause == "sdc"
+
+
+def test_retry_policy_rollback_budget():
+    p = RetryPolicy(max_restarts=3, max_rollbacks=1)
+    corruption = classify_failure(TrainingAnomalyError(6, 3, 8, 3))
+    assert p.allows(0, 0, corruption, rollbacks=0)
+    assert not p.allows(0, 0, corruption, rollbacks=1)  # own budget,
+    #                                 independent of max_restarts=3
+    retry = classify_failure(TimeoutError("x"))
+    assert p.allows(2, 0, retry, rollbacks=1)  # and vice versa
+
+
+def test_blessed_stamp_and_good_only_selection(tmp_path):
+    """Checkpoints saved inside the anomaly window are stamped
+    blessed=False; latest_checkpoint(good_only=True, max_step=...)
+    skips them AND anything past the rollback horizon."""
+    from ray_lightning_tpu.checkpoint import latest_checkpoint
+    from ray_lightning_tpu.core.callbacks import ModelCheckpoint
+
+    ck = tmp_path / "ck"
+    mc = ModelCheckpoint(dirpath=str(ck), monitor=None,
+                         every_n_train_steps=1, save_top_k=-1)
+    _sticky_nan_fit(tmp_path, callbacks=[mc])
+    metas = {}
+    for d in os.listdir(ck):
+        with open(ck / d / "meta.json") as f:
+            metas[d] = json.load(f)
+    assert metas["step=3"]["blessed"] is True
+    assert metas["step=4"]["blessed"] is False   # streak active
+    assert metas["step=4"]["guard"]["streak"] >= 1
+    # newest first is step=6 (unblessed): good_only must land on step=3
+    assert latest_checkpoint(str(ck)).endswith("step=6")
+    marker = read_rollback_marker(str(tmp_path))
+    good = latest_checkpoint(str(ck), good_only=True,
+                             max_step=marker["last_good_step"])
+    assert good.endswith("step=3")
+
+
+def test_retention_never_deletes_last_blessed(tmp_path):
+    """ISSUE 5 satellite: save_top_k pruning inside a long anomaly
+    streak must keep the newest blessed checkpoint even when it falls
+    outside the newest-N window."""
+    from ray_lightning_tpu.core.callbacks import ModelCheckpoint
+
+    ck = tmp_path / "ck"
+    mc = ModelCheckpoint(dirpath=str(ck), monitor=None,
+                         every_n_train_steps=1, save_top_k=1)
+    _sticky_nan_fit(tmp_path, callbacks=[mc])
+    dirs = sorted(d for d in os.listdir(ck) if d.startswith("step="))
+    # newest-1 window = the unblessed step=6; the blessed step=3
+    # survives as the protected rollback target
+    assert "step=3" in dirs, dirs
+    assert "step=6" in dirs, dirs
+    assert "step=4" not in dirs and "step=5" not in dirs, dirs
+
+
+def test_sweep_keep_last_n_protects_blessed(tmp_path):
+    """The sweep-side retention (TuneReportCheckpointCallback
+    keep_last_n) honors the same floor."""
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.checkpoint import save_checkpoint
+    from ray_lightning_tpu.sweep.callbacks import (
+        TuneReportCheckpointCallback,
+    )
+
+    cb = TuneReportCheckpointCallback(keep_last_n=2)
+    for step, blessed in ((1, True), (2, True), (3, False), (4, False),
+                          (5, False)):
+        path = str(tmp_path / f"checkpoint_{step:08d}")
+        save_checkpoint(path, {"w": jnp.full((8,), float(step))},
+                        {"global_step": step, "blessed": blessed})
+        cb._written.append(path)
+    cb._prune()
+    left = sorted(os.listdir(tmp_path))
+    # window = {4, 5} (both unblessed): the newest blessed (2) survives
+    assert "checkpoint_00000002" in left, left
+    assert "checkpoint_00000004" in left and "checkpoint_00000005" in left
+    assert "checkpoint_00000001" not in left and \
+        "checkpoint_00000003" not in left
+
+
+def test_rollback_resume_advances_past_poisoned_window(tmp_path):
+    """Tier-2 resume semantics at Trainer level: restore from the
+    blessed checkpoint + the rollback marker => the poisoned window's
+    batches are SKIPPED, not replayed."""
+    from ray_lightning_tpu.checkpoint import latest_checkpoint
+    from ray_lightning_tpu.core.callbacks import ModelCheckpoint
+    from tests.utils import BoringModel
+
+    ck = tmp_path / "ck"
+    mc = ModelCheckpoint(dirpath=str(ck), monitor=None,
+                         every_n_train_steps=1, save_top_k=-1)
+    _sticky_nan_fit(tmp_path, callbacks=[mc])
+    marker = read_rollback_marker(str(tmp_path))
+    resume_from = latest_checkpoint(str(ck), good_only=True,
+                                    max_step=marker["last_good_step"])
+    assert resume_from.endswith("step=3")
+
+    t2 = _trainer(tmp_path / "resume")
+    t2.resume_skip_past = marker
+    t2.fit(BoringModel(), _loader(), ckpt_path=resume_from)
+    # epoch 0 restored at batch 3, window skipped through batch 6:
+    # 2 batches left of epoch 0 + 8 of epoch 1 on top of the 3 restored
+    assert t2.global_step == 3 + 2 + 8
+
+
+def test_scratch_rollback_still_advances_past_window(tmp_path):
+    """A rollback that found NO blessed checkpoint resumes from scratch
+    — the poisoned window must still be skipped, not replayed."""
+    from tests.utils import BoringModel
+
+    t = _trainer(tmp_path)
+    t.resume_skip_past = {"detected_step": 6, "last_good_step": 3,
+                          "epoch": 0, "epoch_batch": 6}
+    t.fit(BoringModel(), _loader())  # no ckpt_path: scratch
+    # epoch 0 loses its first 6 batches (clean prefix sacrificed with
+    # the window — suspect data is never retrained): 2 + 8 steps
+    assert t.global_step == 10
+
+
+def test_escalation_respects_window_at_sparse_cadence(tmp_path):
+    """K anomalies spread over a gap LONGER than the window must not
+    escalate (the windowed contract), while K consecutive ones must
+    (the in-jit streak counter is cadence-independent)."""
+    from ray_lightning_tpu.resilience.guard import GuardCallback
+
+    class _T:
+        current_epoch = 0
+        _epoch_batches_done = 0
+        global_step = 0
+        default_root_dir = str(tmp_path)
+
+    cb = GuardCallback(GuardConfig(escalate_after=4, escalate_window=16),
+                       marker_dir=str(tmp_path))
+    t = _T()
+    # 4 anomalies spread across a 50-step observation gap: NOT 4-in-16
+    cb._note(t, 50, 0.0, streak=0.0)
+    cb._note(t, 100, 4.0, streak=1.0)  # no escalation
+    # but a 4-step STREAK escalates regardless of the fetch cadence
+    with pytest.raises(TrainingAnomalyError):
+        cb._note(t, 150, 8.0, streak=4.0)
+
+
+def test_rollback_quarantines_poisoned_checkpoints(tmp_path):
+    """After a rollback, checkpoints newer than the last-good step are
+    moved out of the candidate set (quarantined.ckpts/) — a LATER
+    retryable restart must never resurrect a poisoned one."""
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.checkpoint import (
+        latest_checkpoint,
+        save_checkpoint,
+    )
+    from ray_lightning_tpu.resilience.supervisor import (
+        _quarantine_newer_checkpoints,
+    )
+
+    for step in (1, 2, 3, 4):
+        save_checkpoint(str(tmp_path / f"step={step}"),
+                        {"w": jnp.full((8,), float(step))},
+                        {"global_step": step, "blessed": True})
+    _quarantine_newer_checkpoints(str(tmp_path), 2)
+    assert sorted(d for d in os.listdir(tmp_path)
+                  if d.startswith("step=")) == ["step=1", "step=2"]
+    moved = os.listdir(tmp_path / "quarantined.ckpts")
+    assert sorted(m.split(".")[0] for m in moved) == ["step=3", "step=4"]
+    # the plain (non-good_only) selection now lands on the clean one
+    assert latest_checkpoint(str(tmp_path)).endswith("step=2")
+
+
+def test_leaf_digest_sees_every_bit_of_wide_dtypes():
+    """A flip in the LOW 32 bits of a 64-bit word must change the
+    fingerprint (a lossy f32 image would round it away)."""
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.resilience.guard import _leaf_digest
+
+    base = np.array([3, 5, 7], dtype=np.int64)
+    flipped = base.copy()
+    flipped[1] ^= 1 << 4  # low bits of an int64 word
+    a = _leaf_digest(jnp.asarray(base))
+    b = _leaf_digest(jnp.asarray(flipped))
+    assert int(a) != int(b)
+    # bf16 exactness too: one mantissa bit
+    h = np.zeros(4, np.uint16)
+    h[2] = 0x3C00
+    h2 = h.copy()
+    h2[2] ^= 1 << 3
+    ha = _leaf_digest(jnp.asarray(h).view(jnp.bfloat16))
+    hb = _leaf_digest(jnp.asarray(h2).view(jnp.bfloat16))
+    assert int(ha) != int(hb)
+
+
+def test_stale_rollback_marker_is_ignored(tmp_path):
+    """A marker whose detection step is behind the restore point must
+    no-op (it describes an older incident)."""
+    from ray_lightning_tpu.checkpoint import latest_checkpoint
+    from ray_lightning_tpu.core.callbacks import ModelCheckpoint
+    from tests.utils import BoringModel
+
+    ck = tmp_path / "ck"
+    mc = ModelCheckpoint(dirpath=str(ck), monitor=None,
+                         every_n_train_steps=1, save_top_k=-1)
+    t = _trainer(tmp_path, callbacks=[mc])
+    t.fit(BoringModel(), _loader())
+    resume_from = latest_checkpoint(str(ck))  # step=16, end of run
+    t2 = _trainer(tmp_path / "resume")
+    t2.resume_skip_past = {"detected_step": 6, "last_good_step": 3,
+                           "epoch": 0, "epoch_batch": 6}
+    t2.fit(BoringModel(), _loader(), ckpt_path=resume_from)
+    assert t2.global_step == 16  # nothing skipped, nothing replayed
+
+
+# ------------------------------------------------------ tier 3: the SDC
+
+
+def test_diagnose_digests_majority_tie_and_singletons():
+    # 3:1 majority -> the minority device is the suspect
+    assert diagnose_digests([7, 7, 5, 7], [[0, 1, 2, 3]]) == ([2], True)
+    # 1:1 tie -> both suspect (attribution indeterminate)
+    assert diagnose_digests([7, 5], [[0, 1]]) == ([0, 1], True)
+    # agreement -> clean
+    assert diagnose_digests([7, 7], [[0, 1]]) == ([], True)
+    # no redundancy -> not comparable
+    assert diagnose_digests([7, 5], []) == ([], False)
+
+
+def test_replica_groups_dp_vs_fsdp(devices8):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_lightning_tpu.parallel.mesh import MeshSpec
+    from ray_lightning_tpu.resilience.guard import replica_groups
+
+    mesh = MeshSpec(data=8).build(devices8)
+    rep = jax.device_put(jnp.zeros((16, 16)), NamedSharding(mesh, P()))
+    groups = replica_groups({"w": rep}, mesh)
+    assert len(groups) == 1 and len(groups[0]) == 8  # one replica group
+
+    mesh_f = MeshSpec(fsdp=8).build(devices8)
+    sh = jax.device_put(jnp.zeros((16, 16)),
+                        NamedSharding(mesh_f, P("fsdp")))
+    assert replica_groups({"w": sh}, mesh_f) == []  # no redundancy
+
+
+def test_bitflip_detected_within_one_probe_cadence(tmp_path):
+    """A one-bit mantissa flip on device 3's replica: the fingerprint
+    probe catches it at the next cadence and the marker names the
+    divergent device's process."""
+    from ray_lightning_tpu import DataParallel
+    from ray_lightning_tpu.resilience.faults import Fault, FaultInjector
+    from tests.utils import BoringModel
+
+    t = _trainer(tmp_path, strategy=DataParallel(),
+                 guard=GuardConfig(sdc_every_n_steps=2))
+    t.callbacks.append(FaultInjector(
+        [Fault("bitflip_param", None, 3, {"device": "3"}, index=0)]))
+    with pytest.raises(SDCDetectedError) as exc_info:
+        t.fit(BoringModel(), _loader())
+    err = exc_info.value
+    assert err.detected_step == 4          # flip lands at step 3
+    assert err.suspect_ranks == [0]        # single-process: rank 0
+    marker = read_rollback_marker(str(tmp_path))
+    assert marker["kind"] == "sdc" and marker["quarantine"] == [0]
+    assert marker["last_good_step"] == 2   # the step-2 probe passed
+    digests = marker["digests"]
+    assert len(digests) == 8
+    # exactly one device disagrees — and it is the one we flipped
+    counts = {d: digests.count(d) for d in set(digests)}
+    minority = [i for i, d in enumerate(digests) if counts[d] == 1]
+    assert minority == [3]
+    assert classify_failure(err).kind == FailureKind.CORRUPTION
+    assert t.callback_metrics["guard_sdc_probes"] >= 1
+
+
+def test_bitflip_is_invisible_to_tier1(tmp_path):
+    """The whole point of tier 3: a bit-flip corrupts a replica without
+    ever producing a NaN or a spike — with the probe disabled the run
+    finishes 'successfully' with zero skipped steps."""
+    from ray_lightning_tpu import DataParallel
+    from ray_lightning_tpu.resilience.faults import Fault, FaultInjector
+    from tests.utils import BoringModel
+
+    t = _trainer(tmp_path, strategy=DataParallel(),
+                 guard=GuardConfig(sdc_every_n_steps=0))
+    t.callbacks.append(FaultInjector(
+        [Fault("bitflip_param", None, 3, {"device": "3"}, index=0)]))
+    t.fit(BoringModel(), _loader())
+    assert t.callback_metrics["guard_skipped_steps"] == 0
+
+
+# ------------------------------------------------ faults grammar + bench
+
+
+def test_parse_new_fault_kinds():
+    from ray_lightning_tpu.resilience.faults import parse_faults
+
+    faults = parse_faults(
+        "nan_loss:rank=0,step=3,count=5; grad_blowup:rank=*,step=2;"
+        "bitflip_param:rank=1,step=4,bit=7,device=1,element=3")
+    assert [f.kind for f in faults] == ["nan_loss", "grad_blowup",
+                                       "bitflip_param"]
+    assert faults[0].args["count"] == "5"
+    assert faults[2].args == {"bit": "7", "device": "1", "element": "3"}
+
+
+def test_nan_loss_fires_once_across_restarts(tmp_path):
+    """The once-per-rank marker spans restarts: a resumed run sails past
+    the step whose batch poisoned its predecessor."""
+    from ray_lightning_tpu.resilience.faults import Fault, FaultInjector
+    from tests.utils import BoringModel
+
+    state = str(tmp_path / "fault_state")
+    t = _trainer(tmp_path / "a", guard=GuardConfig(warmup_steps=2))
+    t.callbacks.append(FaultInjector(
+        [Fault("nan_loss", None, 3, {}, index=0)], state))
+    t.fit(BoringModel(), _loader())
+    assert t.callback_metrics["guard_skipped_steps"] == 1
+    t2 = _trainer(tmp_path / "b", guard=GuardConfig(warmup_steps=2))
+    t2.callbacks.append(FaultInjector(
+        [Fault("nan_loss", None, 3, {}, index=0)], state))
+    t2.fit(BoringModel(), _loader())
+    assert t2.callback_metrics["guard_skipped_steps"] == 0  # marker held
+
+
+def test_bench_guard_summary_is_backend_free():
+    """Every bench JSON line carries the guard counters, even with the
+    backend down: the summary is a pure jaxpr-level audit."""
+    import bench
+
+    g = bench._guard_summary()
+    assert "guard" in g, g
+    guard = g["guard"]
+    assert guard["effects"] == 0 and guard["extra_host_transfers"] == 0
+    assert {"guard_anomaly", "guard_skipped_steps",
+            "guard_streak", "guard_last_anomaly"} <= set(guard["counters"])
+    for counter in ("skipped_steps", "rollbacks", "sdc_probes",
+                    "last_anomaly"):
+        assert counter in guard
+
+
+# ----------------------------------------- supervised SPMD runs (slow)
+
+
+def _sup_module():
+    from tests.utils import IdSumModel
+
+    return IdSumModel(lr=1e-2)
+
+
+def _sup_trainer():
+    from ray_lightning_tpu import DataParallel, Trainer
+
+    return Trainer(strategy=DataParallel(), max_epochs=2,
+                   enable_progress_bar=False, enable_checkpointing=False,
+                   seed=0, log_every_n_steps=1)
+
+
+def _sup_data():
+    import jax
+
+    from ray_lightning_tpu import DataLoader
+
+    rng = np.random.default_rng(0)
+    x = np.zeros((64, 8), np.float32)
+    x[:, 0] = np.arange(64)
+    y = rng.integers(0, 2, 64).astype(np.int32)
+    return DataLoader({"x": x, "y": y}, batch_size=8,
+                      num_shards=jax.process_count(),
+                      shard_index=jax.process_index())
+
+
+def _guard_resilience(tmp_path, name, guard, faults):
+    from ray_lightning_tpu import ResilienceConfig
+
+    return ResilienceConfig(
+        checkpoint_dir=str(tmp_path / name),
+        policy=RetryPolicy(max_restarts=2, backoff_base_s=0.2,
+                           jitter=0.0),
+        save_every_n_steps=1,
+        heartbeat_interval_s=1.0,
+        stall_timeout_s=0.0,
+        guard=guard,
+        faults=faults,
+    )
+
+
+def _run_supervised(tmp_path, name, guard, faults, devices=1):
+    from ray_lightning_tpu import fit_supervised
+
+    return fit_supervised(
+        _sup_module, _sup_trainer, _sup_data, 2,
+        resilience=_guard_resilience(tmp_path, name, guard, faults),
+        log_dir=str(tmp_path / f"logs_{name}"), platform="cpu",
+        num_cpu_devices_per_process=devices, timeout=420,
+        return_weights=False)
+
+
+@pytest.mark.slow
+def test_supervise_nan_skip_no_restart(tmp_path):
+    """Tier 1 under real 2-proc SPMD: the poisoned batch is skipped
+    inside the compiled step — the processes never die, the supervisor
+    never restarts, and the run converges."""
+    sup = _run_supervised(tmp_path, "nan",
+                          GuardConfig(warmup_steps=2),
+                          "nan_loss:rank=0,step=3")
+    assert sup.total_attempts == 1 and sup.rollbacks == 0
+    assert sup.result.metrics["guard_skipped_steps"] >= 1
+    assert np.isfinite(sup.result.metrics["loss"])
+
+
+@pytest.mark.slow
+def test_supervise_corruption_rollback_from_blessed(tmp_path):
+    """Tier 2 end to end: a sustained NaN streak escalates, the
+    supervisor rolls back to the blessed checkpoint at/below the
+    marker's last-good step, the data order advances past the poisoned
+    window, and the resumed run completes."""
+    sup = _run_supervised(tmp_path, "streak",
+                          GuardConfig(warmup_steps=1, escalate_after=3,
+                                      escalate_window=8),
+                          "nan_loss:rank=0,step=4,count=6")
+    assert sup.rollbacks == 1 and sup.restarts == 0
+    [failure] = sup.failures
+    assert failure["kind"] == "corruption"
+    assert failure["cause"] == "anomaly-streak"
+    marker = read_rollback_marker(str(tmp_path / "streak"))
+    assert marker["last_good_step"] == 3
+    assert marker["rollbacks_performed"] == 1
+    # the blessed rollback target survived retention and still exists
+    assert os.path.isdir(tmp_path / "streak" / "step=3")
+    assert sup.result.metrics["guard_rollbacks"] == 1.0
+    assert np.isfinite(sup.result.metrics["loss"])
+
+
+@pytest.mark.slow
+def test_supervise_bitflip_quarantines_rank1(tmp_path):
+    """Tier 3 end to end (2 proc x 2 devices = 4 replicas): the flip on
+    rank 1's device is outvoted 3:1 within one probe cadence, rank 1 is
+    quarantined in the ledger AND on disk, and the run resumes from a
+    probe-verified checkpoint."""
+    from ray_lightning_tpu.resilience.guard import QUARANTINE_FILE
+
+    sup = _run_supervised(tmp_path, "sdc",
+                          GuardConfig(sdc_every_n_steps=2),
+                          "bitflip_param:rank=1,step=3,device=0",
+                          devices=2)
+    assert sup.rollbacks == 1 and sup.quarantined == [1]
+    [failure] = sup.failures
+    assert failure["kind"] == "corruption" and failure["cause"] == "sdc"
+    with open(tmp_path / "sdc" / QUARANTINE_FILE) as f:
+        assert json.load(f)["excluded"] == [1]
+    marker = read_rollback_marker(str(tmp_path / "sdc"))
+    assert marker["kind"] == "sdc" and marker["quarantine"] == [1]
+    assert np.isfinite(sup.result.metrics["loss"])
