@@ -12,10 +12,22 @@ cd "$(dirname "$0")"
 
 RUFF_ARGS=(check ray_lightning_tpu tests examples bench.py __graft_entry__.py)
 
-if [[ "${1:-}" == "--check" ]]; then
-    ruff "${RUFF_ARGS[@]}"
+# ruff is optional tooling: skip (loudly) on boxes that don't ship it so
+# the semantic gates below still run — shardcheck/tracecheck are the
+# gates that need THIS repo's toolchain, ruff is style only. CI images
+# that DO ship ruff should export RLT_REQUIRE_RUFF=1 so a PATH break
+# cannot silently drop the style gate.
+if command -v ruff > /dev/null 2>&1; then
+    if [[ "${1:-}" == "--check" ]]; then
+        ruff "${RUFF_ARGS[@]}"
+    else
+        ruff "${RUFF_ARGS[@]}" --fix
+    fi
+elif [[ "${RLT_REQUIRE_RUFF:-}" == "1" ]]; then
+    echo "format.sh: ruff not installed but RLT_REQUIRE_RUFF=1" >&2
+    exit 1
 else
-    ruff "${RUFF_ARGS[@]}" --fix
+    echo "format.sh: ruff not installed — skipping style pass" >&2
 fi
 
 # shardcheck has no fix mode; it gates both invocations identically.
@@ -29,6 +41,33 @@ JAX_PLATFORMS=cpu python -m ray_lightning_tpu lint \
 JAX_PLATFORMS=cpu python -m ray_lightning_tpu trace llama3-8b \
     --topo v5p-64 --json --fail-on error > /dev/null
 
+# collective-overlap gate (docs/PERFORMANCE.md "collective overlap"):
+# the same flagship step under the strategy's overlap="on" knob must
+# audit clean AND hide >= 70% of its prefetchable ZeRO collective time
+# behind compute per tracecheck's roofline model (ISSUE 6 acceptance).
+JAX_PLATFORMS=cpu python -m ray_lightning_tpu trace llama3-8b \
+    --topo v5p-64 --overlap on --json --fail-on error \
+    | python -c '
+import json, sys
+r = json.load(sys.stdin)
+frac = r.get("overlap_hidden_fraction", 0.0)
+assert r.get("overlap", {}).get("scheduled"), "prefetch schedule missing"
+assert frac >= 0.7, f"overlap_hidden_fraction {frac} < 0.7"
+print(f"overlap gate: {frac:.0%} of prefetchable ICI time hidden")'
+
+# bench regression ratchet (scripts/bench_gate.py): the freshest bench
+# JSON line must not regress the best prior BENCH_r0*.json round on any
+# ratcheted metric (tokens/sec/chip, mfu, overlap_hidden_fraction). On
+# a box with no TPU the bench emits its structured backend-down skip
+# line within seconds (retry budget pinned down here), which passes the
+# gate by design — the ratchet gates merit, not machine availability.
+# Running the REAL bench.py (not a cached trace JSON) is deliberate:
+# this gate doubles as the end-to-end proof that bench.py's structured
+# skip contract holds, which is itself a pinned behavior (BENCH_r05).
+{ JAX_PLATFORMS=tpu RLT_BENCH_MAX_WAIT=10 RLT_BENCH_INIT_RETRIES=1 \
+    python bench.py 2>/dev/null || true; } \
+    | python scripts/bench_gate.py -
+
 # resilience gate, three supervised CPU-SPMD legs: (1) an injected
 # worker kill must auto-resume from the step-cadence checkpoint and
 # converge (kill -> classify -> relaunch -> resume, end to end); (2) an
@@ -40,8 +79,11 @@ JAX_PLATFORMS=cpu python -m ray_lightning_tpu trace llama3-8b \
 # "fault-injection cookbook".
 JAX_PLATFORMS=cpu python -m ray_lightning_tpu supervise --smoke > /dev/null
 
-# prefetch-overlap gate: a slow-loader CPU run must show pipeline
-# occupancy > 0 (the device prefetcher demonstrably kept batches
-# resident ahead of the step) — docs/PERFORMANCE.md. Exit 1 otherwise.
+# prefetch-overlap + collective-overlap smoke: a slow-loader CPU run
+# must show pipeline occupancy > 0 (the device prefetcher demonstrably
+# kept batches resident ahead of the step), the overlap jaxpr must
+# carry the prefetch fingerprint with the off-trace flagging RLT305,
+# and the throttled fake-collective interleave demo must beat the
+# serial schedule — docs/PERFORMANCE.md. Exit 1 otherwise.
 JAX_PLATFORMS=cpu python -m ray_lightning_tpu perf --smoke --steps 25 \
     > /dev/null

@@ -163,7 +163,7 @@ def format_findings(findings: List[Finding]) -> str:
 # example (examples parse argv, build trainers, and train).
 
 
-def _build_llama_fsdp(topo):
+def _build_llama_fsdp(topo, overlap: str = "off"):
     import numpy as np
 
     from ray_lightning_tpu.models.llama import LlamaConfig, LlamaModule
@@ -183,7 +183,9 @@ def _build_llama_fsdp(topo):
         cfg = LlamaConfig.tiny(use_flash=True)
         batch, seq = 2 * n, min(256, cfg.max_seq_len)
         label = f"llama-tiny FSDP({n})"
-    return (LlamaModule(cfg), ShardedMesh(fsdp=n),
+    if overlap != "off":
+        label += f" overlap={overlap}"
+    return (LlamaModule(cfg), ShardedMesh(fsdp=n, overlap=overlap),
             {"tokens": np.zeros((batch, seq + 1), np.int32)}, label)
 
 
@@ -265,6 +267,13 @@ def add_trace_parser(sub) -> None:
         help="target topology <family>-<chips>, e.g. v5p-64 "
              "(families: v3 v4 v5e v5p v6e cpu)")
     p.add_argument(
+        "--overlap", choices=("off", "on", "serial"), default="off",
+        help="trace the llama targets with the collective-overlap "
+             "schedule (strategy overlap= knob, docs/PERFORMANCE.md "
+             "'collective overlap'); tracecheck then classifies each "
+             "collective hidden-vs-exposed against the prefetch "
+             "schedule it finds in the jaxpr")
+    p.add_argument(
         "--hbm-bytes", type=int, default=None,
         help="per-device usable HBM override in bytes")
     p.add_argument(
@@ -281,12 +290,18 @@ def add_trace_parser(sub) -> None:
                    default=argparse.SUPPRESS)
 
 
-def resolve_trace_target(target: str, topo):
+def resolve_trace_target(target: str, topo, overlap: str = "off"):
     """Resolve a trace target to ``(module, strategy, batch, label)``.
-    Returns None when the target is not recognizable (exit-2 path)."""
+    Returns None when the target is not recognizable (exit-2 path).
+    ``overlap`` reaches builders that take the knob (the llama FSDP
+    targets); others ignore it silently — the knob is advisory."""
     base = os.path.basename(target)
     builder = _TRACE_BUILDERS.get(base) or _TRACE_BUILDERS.get(target)
     if builder is not None:
+        import inspect
+
+        if "overlap" in inspect.signature(builder).parameters:
+            return builder(topo, overlap=overlap)
         return builder(topo)
     if ":" in target and os.sep not in target:
         mod_name, _, fn_name = target.partition(":")
@@ -323,7 +338,9 @@ def run_trace(args) -> int:
     except ValueError as exc:
         return invalid(str(exc))
     try:
-        built = resolve_trace_target(args.target, topo)
+        built = resolve_trace_target(args.target, topo,
+                                     overlap=getattr(args, "overlap",
+                                                     "off"))
     except Exception as exc:  # noqa: BLE001 — a factory that raises is
         # an invalid invocation, not a finding
         return invalid(f"building {args.target!r} failed: "

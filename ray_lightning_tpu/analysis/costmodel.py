@@ -23,7 +23,14 @@ Model assumptions (documented in docs/STATIC_ANALYSIS.md):
     hops = n-1 for ring collectives and 1 for a neighbor permute;
   * DCN (multi-slice) is out of scope: tracecheck audits one slice, the
     mesh layer already refuses meshes whose non-data axes span slices
-    (parallel/mesh.py order_devices_for_slices).
+    (parallel/mesh.py order_devices_for_slices);
+  * the overlap model (`compute_time_us`, consumed by tracecheck's
+    hidden-vs-exposed classification): a scanned body's per-trip compute
+    window is its counted matmul FLOPs (dot_general only — pallas
+    kernels and elementwise work are NOT counted, an undercount that
+    makes the hidden fraction conservative) over the chip's spec-sheet
+    peak derated by MXU_EFFICIENCY. A prefetch-scheduled collective is
+    hidden up to that window; what does not fit stays exposed.
 """
 from __future__ import annotations
 
@@ -35,8 +42,9 @@ from typing import Dict, Mapping, Optional, Tuple
 from ray_lightning_tpu.parallel.plan import hbm_bytes_for_kind
 
 __all__ = [
-    "Topology", "CollectiveCost", "ICI_SPECS", "parse_topology",
-    "topology_for_kind", "collective_cost",
+    "Topology", "CollectiveCost", "ICI_SPECS", "MXU_EFFICIENCY",
+    "parse_topology", "topology_for_kind", "collective_cost",
+    "compute_time_us",
 ]
 
 #: ICI spec sheet per device family: (device_kind for the HBM table,
@@ -75,6 +83,16 @@ class Topology:
     ici_gbps: float       # aggregate ICI bandwidth per chip, GB/s
     ici_hop_latency_us: float
     hbm_bytes: int        # usable HBM per chip
+    #: spec-sheet peak bf16 TFLOP/s per chip — the compute side of the
+    #: overlap model's roofline. None resolves from device_kind via the
+    #: utils/probe.py table (one source of truth), so a directly
+    #: constructed Topology prices compute the same as parse_topology.
+    peak_tflops: Optional[float] = None
+
+    def __post_init__(self) -> None:
+        if self.peak_tflops is None:
+            object.__setattr__(
+                self, "peak_tflops", _peak_tflops(self.device_kind))
 
     @property
     def hbm_gib(self) -> float:
@@ -131,6 +149,33 @@ def topology_for_kind(device_kind: str, n_devices: int, *,
     return Topology(name=f"{family}-{n_devices}", device_kind=device_kind,
                     n_devices=n_devices, ici_gbps=gbps,
                     ici_hop_latency_us=lat, hbm_bytes=int(hbm_bytes))
+
+
+def _peak_tflops(device_kind: str) -> float:
+    """Spec-sheet peak for the overlap roofline — one source of truth
+    with the bench/doctor probe (utils/probe.py); unknown kinds get the
+    v5e-class fallback, same contract as the probe."""
+    from ray_lightning_tpu.utils.probe import device_peak_tflops
+
+    return float(device_peak_tflops(device_kind))
+
+
+#: fraction of spec-sheet peak a well-tuned matmul-dominated step
+#: actually sustains — the compute window for hiding collectives is
+#: charged at peak x efficiency. 0.6 is the repo's own measured MFU
+#: band at the flagship shapes (BENCH_r03: 0.59 best); a HIGHER
+#: efficiency would shrink the window and under-claim hiding, a lower
+#: one would over-claim. Documented in docs/STATIC_ANALYSIS.md.
+MXU_EFFICIENCY = 0.6
+
+
+def compute_time_us(flops: float, topo: Topology) -> float:
+    """Time to execute ``flops`` per-device FLOPs on one chip of
+    ``topo`` at the derated roofline — the overlap model's per-trip
+    compute window."""
+    if flops <= 0:
+        return 0.0
+    return flops / (topo.peak_tflops * 1e12 * MXU_EFFICIENCY) * 1e6
 
 
 @dataclasses.dataclass(frozen=True)

@@ -102,6 +102,14 @@ RULES: Dict[str, Rule] = {r.id: r for r in (
          "un-prefetched device_put on the critical path — each one "
          "drains the device dispatch queue; fetch on a cadence and use "
          "the device prefetch pipeline (docs/PERFORMANCE.md)"),
+    Rule("RLT305", "exposed-collective-in-scan", "warning",
+         "a blocking collective inside a scanned layer body whose "
+         "operand is loop-invariant (a ZeRO/FSDP weight gather of a "
+         "parameter slice — prefetchable one trip ahead) sits exposed "
+         "on the critical path every trip; enable the sharding plan's "
+         "overlap knob (FSDP/ShardedMesh(overlap='on')) to hide it "
+         "behind the previous layer's compute "
+         "(docs/PERFORMANCE.md 'collective overlap')"),
     Rule("RLT303", "ring-deadlock", "error",
          "a ppermute permutation is not a valid schedule (duplicate "
          "source/destination, out-of-range rank, a full permutation "

@@ -60,13 +60,14 @@ from typing import (
 )
 
 from ray_lightning_tpu.analysis.costmodel import (
-    Topology, collective_cost, parse_topology,
+    Topology, collective_cost, compute_time_us, parse_topology,
 )
 from ray_lightning_tpu.analysis.findings import Finding
+from ray_lightning_tpu.ops.dispatch import OVERLAP_PREFETCH_NAME
 
 __all__ = [
-    "CollectiveEvent", "TraceReport", "audit_step", "trace_step",
-    "check_permutation",
+    "CollectiveEvent", "TraceReport", "audit_step", "classify_overlap",
+    "trace_step", "check_permutation",
 ]
 
 #: per-dim mesh axes; None = unknown (propagation gave up — never a
@@ -85,7 +86,7 @@ _PASSTHROUGH = {
     "tanh", "asinh", "acosh", "atanh", "logistic", "sqrt", "rsqrt",
     "cbrt", "integer_pow", "sign", "abs", "floor", "ceil", "round",
     "is_finite", "not", "erf", "erfc", "erf_inv", "real", "imag",
-    "stop_gradient", "name", "optimization_barrier", "cumsum", "cumprod",
+    "stop_gradient", "name", "cumsum", "cumprod",
     "cummax", "cummin", "cumlogsumexp", "nan_to_num", "population_count",
     "clz", "copy_start", "copy_done", "reduce_precision", "square",
     "conj", "bitcast_convert_type",
@@ -153,7 +154,16 @@ class CollectiveEvent:
     ``implicit`` marks collectives *inferred* from sharding propagation
     (GSPMD will insert them) as opposed to explicit shard_map
     collectives; ``unbounded`` marks sites inside a while-loop whose trip
-    count the trace cannot know (counted once)."""
+    count the trace cannot know (counted once).
+
+    Overlap accounting (docs/STATIC_ANALYSIS.md "overlap model"):
+    ``prefetchable`` marks collectives whose operand is known ahead of
+    its use — ZeRO weight gathers (parameter-derived operands) and the
+    grad reduce-scatters matched to a parameter; ``scope`` is the id of
+    the enclosing scanned body (None at top level); ``hidden_us`` is the
+    share of ``time_us`` the overlap classification proved hideable
+    behind that scope's per-trip compute window (0 when the traced
+    program carries no prefetch schedule)."""
 
     kind: str
     axes: Tuple[str, ...]
@@ -165,10 +175,21 @@ class CollectiveEvent:
     source: str
     param_path: Optional[str] = None
     unbounded: bool = False
+    prefetchable: bool = False
+    scope: Optional[int] = None
+    hidden_us: float = 0.0
+
+    @property
+    def exposed_us(self) -> float:
+        return max(0.0, self.time_us - self.hidden_us)
 
     def describe(self) -> str:
         tag = "implicit" if self.implicit else "explicit"
         extra = " trip-count-unknown" if self.unbounded else ""
+        if self.hidden_us > 0 and self.time_us > 0:
+            extra += f" {self.hidden_us / self.time_us:.0%}-hidden"
+        elif self.prefetchable and self.scope is not None:
+            extra += " exposed"
         who = f"  <{self.param_path}>" if self.param_path else ""
         return (f"{self.kind:<14} axes={','.join(self.axes) or '-'} "
                 f"x{self.count:<4} {_fmt_bytes(self.wire_bytes)} wire "
@@ -199,6 +220,10 @@ class TraceReport:
     peak_hbm_bytes: int
     hbm_budget_bytes: int
     label: str = ""
+    #: the overlap classification (`classify_overlap`): scheduled flag,
+    #: hidden/exposed ICI time, per-scope breakdown. None only when
+    #: classification was skipped.
+    overlap: Optional[Dict[str, Any]] = None
 
     @property
     def ici_bytes_per_step(self) -> int:
@@ -207,6 +232,26 @@ class TraceReport:
     @property
     def ici_time_us(self) -> float:
         return sum(e.time_us for e in self.collectives)
+
+    @property
+    def ici_hidden_us(self) -> float:
+        return sum(e.hidden_us for e in self.collectives)
+
+    @property
+    def ici_exposed_us(self) -> float:
+        return sum(e.exposed_us for e in self.collectives)
+
+    @property
+    def overlap_hidden_fraction(self) -> float:
+        """Fraction of the PREFETCHABLE collective time (ZeRO weight
+        gathers + param-matched grad reduce-scatters) the schedule
+        hides behind compute; 0.0 when nothing is prefetchable or no
+        overlap schedule is present."""
+        pref = sum(e.time_us for e in self.collectives if e.prefetchable)
+        if pref <= 0:
+            return 0.0
+        return sum(e.hidden_us for e in self.collectives
+                   if e.prefetchable) / pref
 
     @property
     def fits(self) -> bool:
@@ -237,6 +282,20 @@ class TraceReport:
                 f"ICI total: {self.ici_bytes_per_step / gib:.3f} GiB/step "
                 f"on the wire, ~{self.ici_time_us / 1e3:.2f} ms serialized "
                 f"({self.topology.ici_gbps:.0f} GB/s per chip)")
+            ov = self.overlap or {}
+            lines.append(
+                f"overlap: {'prefetch schedule detected' if ov.get('scheduled') else 'no prefetch schedule (overlap=off)'}"
+                f" — {self.overlap_hidden_fraction:.0%} of prefetchable "
+                f"collective time hidden behind compute "
+                f"({self.ici_hidden_us / 1e3:.2f} ms hidden, "
+                f"{self.ici_exposed_us / 1e3:.2f} ms exposed)")
+            for sc in ov.get("per_scope", ()):
+                lines.append(
+                    f"  scope {sc['source']} x{sc['trips']}: "
+                    f"compute {sc['compute_us_per_trip']:.0f} us/trip vs "
+                    f"prefetchable comm "
+                    f"{sc['prefetch_comm_us_per_trip']:.0f} us/trip -> "
+                    f"{sc['hidden_fraction']:.0%} hidden")
         else:
             lines.append("collective schedule: none (single-device or "
                          "fully replicated step)")
@@ -267,13 +326,20 @@ class TraceReport:
             "mesh": self.mesh_axes,
             "ici_bytes_per_step": self.ici_bytes_per_step,
             "ici_time_us": round(self.ici_time_us, 1),
+            "ici_hidden_us": round(self.ici_hidden_us, 1),
+            "ici_exposed_us": round(self.ici_exposed_us, 1),
+            "overlap_hidden_fraction": round(
+                self.overlap_hidden_fraction, 4),
+            "overlap": self.overlap,
             "collectives": [
                 {"kind": e.kind, "axes": list(e.axes),
                  "payload_bytes": e.payload_bytes, "count": e.count,
                  "wire_bytes": e.wire_bytes,
                  "time_us": round(e.time_us, 1), "implicit": e.implicit,
                  "source": e.source, "param_path": e.param_path,
-                 "unbounded": e.unbounded}
+                 "unbounded": e.unbounded,
+                 "prefetchable": e.prefetchable, "scope": e.scope,
+                 "hidden_us": round(e.hidden_us, 1)}
                 for e in sorted(self.collectives,
                                 key=lambda e: -e.wire_bytes)
             ],
@@ -381,6 +447,14 @@ class _StepAuditor:
         self._findings: Dict[Tuple, Finding] = {}
         self._quiet = 0          # scan-fixpoint passes record nothing
         self._unbounded = 0      # inside while bodies
+        #: overlap accounting: one entry per scanned body (the FINAL,
+        #: recording walk), keyed by a fresh id — trips, per-trip
+        #: dot_general FLOPs (per-device), source, prefetch marker
+        self.scopes: Dict[int, Dict[str, Any]] = {}
+        self._scope_stack: List[int] = []
+        #: the traced program carries the double-buffer fingerprint
+        #: (ops.dispatch.OVERLAP_PREFETCH_NAME name equations)
+        self.saw_prefetch_marker = False
 
     # ---- bookkeeping ----------------------------------------------------
 
@@ -406,7 +480,8 @@ class _StepAuditor:
 
     def record(self, kind: str, payload: int, axes: Sequence[str],
                mult: int, *, implicit: bool, source: str,
-               param_path: Optional[str] = None) -> None:
+               param_path: Optional[str] = None,
+               prefetchable: bool = False) -> None:
         if self._quiet or not axes:
             return
         group = {ax: self.sizes.get(ax, 1) for ax in axes}
@@ -415,8 +490,9 @@ class _StepAuditor:
         cost = collective_cost(kind if kind in (
             "psum", "all_gather", "reduce_scatter", "all_to_all",
             "ppermute") else "psum", payload, group, self.topo)
+        scope = self._scope_stack[-1] if self._scope_stack else None
         key = (kind, tuple(sorted(axes)), payload, source, implicit,
-               bool(self._unbounded))
+               bool(self._unbounded), scope, prefetchable)
         ev = self._events.get(key)
         if ev is None:
             self._events[key] = CollectiveEvent(
@@ -424,7 +500,8 @@ class _StepAuditor:
                 count=mult, wire_bytes=cost.wire_bytes * mult,
                 time_us=cost.time_us * mult, implicit=implicit,
                 source=source, param_path=param_path,
-                unbounded=bool(self._unbounded))
+                unbounded=bool(self._unbounded),
+                prefetchable=prefetchable, scope=scope)
         else:
             ev.count += mult
             ev.wire_bytes += cost.wire_bytes * mult
@@ -494,7 +571,8 @@ class _StepAuditor:
                      if info.spec is not None else None)
         payload = self._aval_bytes(aval, remaining)
         self.record("all_gather", payload, sorted(axes), mult,
-                    implicit=True, source=source, param_path=info.path)
+                    implicit=True, source=source, param_path=info.path,
+                    prefetchable=info.param)
         if not info.param:
             self.flag(
                 "RLT301",
@@ -612,7 +690,7 @@ class _StepAuditor:
             payload = self._aval_bytes(out_aval, tuple(out_spec))
             self.record("reduce_scatter", payload, sorted(partial),
                         mult, implicit=True, source=source,
-                        param_path=mpath or path)
+                        param_path=mpath or path, prefetchable=True)
             return tuple(s | m for s, m in zip(out_spec, mspec))
         payload = self._aval_bytes(out_aval, tuple(out_spec))
         self.record("psum", payload, sorted(partial), mult,
@@ -689,6 +767,17 @@ class _StepAuditor:
         src = self._src(eqn)
         sub_peak = 0
 
+        if (name == "name"
+                and eqn.params.get("name") == OVERLAP_PREFETCH_NAME):
+            # the double-buffer fingerprint (ops.dispatch.prefetch_named):
+            # this trace runs the overlap schedule. Stamp only during
+            # the recording walk (same guard as the FLOP counter): a
+            # scan-fixpoint pass runs BEFORE the inner scope is pushed,
+            # so stamping there would credit the ENCLOSING scope
+            self.saw_prefetch_marker = True
+            if self._scope_stack and not self._quiet:
+                self.scopes[self._scope_stack[-1]]["marker"] = True
+
         def set_all(info_list):
             for v, info in zip(out, info_list):
                 env[v] = info
@@ -707,7 +796,23 @@ class _StepAuditor:
             else:
                 set_all([_VarInfo(None, param=param) for _ in out])
 
-        if name in _PASSTHROUGH:
+        if name == "optimization_barrier":
+            # positional identity: each output mirrors ITS input (the
+            # generic passthrough would smear the first operand's spec
+            # over every output — for the overlap barrier that would
+            # hand the activation a weight layout and invent reshards)
+            set_all([dataclasses.replace(i) for i in infos[:len(out)]])
+        elif name == "shard_alike":
+            # jax.experimental.shard_alike: both outputs leave with the
+            # UNIFIED layout. The model adopts the first operand's known
+            # spec for both (the overlap path's only use pins each grad
+            # leaf to its param shard's layout — losing this to unknown
+            # used to charge every stacked layer grad at full size).
+            known = next((i for i in infos if i.spec is not None),
+                         _VarInfo(None))
+            set_all([_VarInfo(known.spec, param=i.param, path=i.path)
+                     for i in infos[:len(out)]])
+        elif name in _PASSTHROUGH:
             base = next((i for i, a in zip(infos, avals)
                          if a is not None and i.spec is not None
                          and len(i.spec) == len(getattr(
@@ -981,10 +1086,40 @@ class _StepAuditor:
                 out_spec[lose_d] = out_spec[lose_d] - {ax}
                 if lose_d == prev:
                     seen[ax] = d
+        self._charge_flops(eqn, avals, out_spec, partial)
         spec = self._resolve_partial(
             eqn.outvars[0].aval, out_spec, partial, mult, src,
             li.path if li.param else ri.path if ri.param else None)
         return _VarInfo(spec, param=li.param and ri.param)
+
+    def _charge_flops(self, eqn, avals, out_spec, partial) -> None:
+        """Accumulate this dot_general's per-device FLOPs into the
+        innermost scan scope — the compute window the overlap model
+        hides collectives behind. Per-device: the full contraction's
+        2·B·M·N·K divided by the product of mesh axes sharding the
+        output or reduced over (how SPMD splits the work). Counted only
+        on the recording walk, once per syntactic equation — i.e. per
+        scan trip."""
+        if self._quiet or not self._scope_stack:
+            return
+        try:
+            (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+            lshape = tuple(getattr(avals[0], "shape", ()))
+            rshape = tuple(getattr(avals[1], "shape", ()))
+            batch = math.prod(lshape[d] for d in lb) or 1
+            k = math.prod(lshape[d] for d in lc) or 1
+            m = math.prod(lshape[d] for d in range(len(lshape))
+                          if d not in tuple(lc) + tuple(lb)) or 1
+            n = math.prod(rshape[d] for d in range(len(rshape))
+                          if d not in tuple(rc) + tuple(rb)) or 1
+            axes = set(partial)
+            for s in out_spec:
+                axes |= s
+            div = math.prod(self.sizes.get(ax, 1) for ax in axes) or 1
+            self.scopes[self._scope_stack[-1]]["flops"] += (
+                2.0 * batch * m * n * k / div)
+        except Exception:  # noqa: BLE001 — accounting must not abort
+            pass
 
     def _gather_prim(self, eqn, infos, avals, mult, src) -> _VarInfo:
         """lax.gather (embedding lookups, take_along_axis): output batch
@@ -1124,7 +1259,8 @@ class _StepAuditor:
                 payload = self._aval_bytes(aval, annotated)
                 self.record("all_gather", payload, sorted(lost), mult,
                             implicit=False, source=src,
-                            param_path=info.path)
+                            param_path=info.path,
+                            prefetchable=info.param)
         return _VarInfo(annotated, param=info.param, path=info.path)
 
     def _scan(self, eqn, infos, env, mult, manual) -> int:
@@ -1164,8 +1300,15 @@ class _StepAuditor:
                             param=a.param and b.param, path=a.path)
             if not changed:
                 break
-        sub_peak, outs = self._seed_and_walk(
-            closed, consts + carry + xs, env, mult * length, manual)
+        sid = len(self.scopes)
+        self.scopes[sid] = {"trips": length, "flops": 0.0,
+                            "source": self._src(eqn), "marker": False}
+        self._scope_stack.append(sid)
+        try:
+            sub_peak, outs = self._seed_and_walk(
+                closed, consts + carry + xs, env, mult * length, manual)
+        finally:
+            self._scope_stack.pop()
         final = outs[:ncar]
         ys = [_VarInfo((frozenset(),) + i.spec if i.spec is not None
                        else None, param=i.param, path=i.path)
@@ -1339,6 +1482,91 @@ def _collective_signature(jaxpr) -> List[Tuple[str, Tuple]]:
 
 
 # --------------------------------------------------------------------------
+# overlap classification (hidden vs exposed collective time)
+# --------------------------------------------------------------------------
+
+
+def classify_overlap(
+    events: Sequence[CollectiveEvent],
+    scopes: Mapping[int, Mapping[str, Any]],
+    topo: Topology,
+    scheduled: Optional[bool] = None,
+) -> Dict[str, Any]:
+    """Classify each collective as hidden-behind-compute vs exposed and
+    annotate ``events`` in place (``hidden_us``).
+
+    The model (docs/STATIC_ANALYSIS.md "overlap model"):
+
+      * only PREFETCHABLE collectives inside a scanned body are
+        hideable — ZeRO weight gathers (operands known ahead of use)
+        and param-matched grad reduce-scatters (retired per trip by the
+        backward scan);
+      * a scope's per-trip compute window is its counted dot_general
+        FLOPs at the derated roofline (`costmodel.compute_time_us`);
+        pallas kernels and elementwise work are not counted, so the
+        window — and with it the hidden share — is conservative;
+      * ``scheduled`` is the program-wide flag (the double-buffer
+        fingerprint `ops.dispatch.OVERLAP_PREFETCH_NAME` anywhere in
+        the trace; when None it defaults to "any scope carries the
+        marker") — but hidden credit is PER SCOPE: a scope earns it
+        only when its source location is one a marker was seen in.
+        The backward scan is the transpose of the marked forward and
+        shares its source (marker-free by construction, still
+        credited); an unrelated scan in the same program — e.g. the
+        fused-CE chunk loop — is NOT part of the schedule and hides
+        nothing, no matter how large its compute window;
+      * per scope: hidden fraction = min(1, window / per-trip
+        prefetchable comm); each event hides that fraction of its time.
+        A zero-compute scope (the pathological case: nothing to hide
+        behind) hides nothing.
+
+    Returns the overlap summary dict carried by `TraceReport.overlap`.
+    """
+    if scheduled is None:
+        scheduled = any(s.get("marker") for s in scopes.values())
+    marked_sources = {str(s.get("source", f"scan#{sid}"))
+                      for sid, s in scopes.items() if s.get("marker")}
+    for e in events:
+        e.hidden_us = 0.0
+    per_scope: List[Dict[str, Any]] = []
+    for sid in sorted(scopes):
+        sc = scopes[sid]
+        evs = [e for e in events if e.scope == sid and e.prefetchable]
+        if not evs:
+            continue
+        source = str(sc.get("source", f"scan#{sid}"))
+        in_schedule = source in marked_sources
+        trips = max(1, int(sc.get("trips", 1)))
+        comm = sum(e.time_us for e in evs)
+        comm_trip = comm / trips
+        window = compute_time_us(float(sc.get("flops", 0.0)), topo)
+        frac = 0.0
+        if scheduled and in_schedule and comm_trip > 0:
+            frac = min(1.0, window / comm_trip)
+        for e in evs:
+            e.hidden_us = e.time_us * frac
+        per_scope.append({
+            "source": source,
+            "trips": trips,
+            "scheduled": in_schedule,
+            "compute_us_per_trip": round(window, 1),
+            "prefetch_comm_us_per_trip": round(comm_trip, 1),
+            "hidden_fraction": round(frac, 4),
+        })
+    pref = sum(e.time_us for e in events if e.prefetchable)
+    hidden = sum(e.hidden_us for e in events)
+    total = sum(e.time_us for e in events)
+    return {
+        "scheduled": bool(scheduled),
+        "overlap_hidden_fraction": round(hidden / pref, 4) if pref else 0.0,
+        "ici_hidden_us": round(hidden, 1),
+        "ici_exposed_us": round(total - hidden, 1),
+        "prefetchable_time_us": round(pref, 1),
+        "per_scope": per_scope,
+    }
+
+
+# --------------------------------------------------------------------------
 # building + auditing the canonical step
 # --------------------------------------------------------------------------
 
@@ -1390,6 +1618,7 @@ def trace_step(module, strategy, n_devices: int, example_batch: Any):
             return params, opt_state, loss, metrics
 
         closed = jax.make_jaxpr(step)(a_params, a_opt, a_batch, a_key)
+    closed = _dce(closed)
 
     meta = {
         "spec": spec,
@@ -1404,6 +1633,28 @@ def trace_step(module, strategy, n_devices: int, example_batch: Any):
         "batch_pspec": strategy.batch_spec(),
     }
     return closed, meta
+
+
+def _dce(closed):
+    """Dead-code-eliminate the traced jaxpr (all outputs kept, all
+    invars kept) so the audit walks the program XLA actually compiles.
+    jit runs the same pass before lowering; without it the walk charges
+    vestigial residuals AD plumbing leaves behind — e.g. grad-of-scan
+    under the overlap schedule stacks the gathered weight carry as ys
+    that NOTHING in the backward scan consumes (measured: a phantom
+    full-stack copy, ~26 GiB on llama3-8b). Degrades to the raw jaxpr
+    if the DCE helper is unavailable."""
+    try:
+        import jax
+        from jax.interpreters import partial_eval as _pe
+
+        jaxpr, _ = _pe.dce_jaxpr(
+            closed.jaxpr, [True] * len(closed.jaxpr.outvars),
+            instantiate=True)
+        return jax.core.ClosedJaxpr(jaxpr, closed.consts)
+    except Exception:  # noqa: BLE001 — an uncooperative jax version
+        # costs precision, never the audit
+        return closed
 
 
 def audit_step(
@@ -1500,7 +1751,43 @@ def audit_step(
         auditor._aval_bytes(leaf, s.spec)
         for (_, leaf), s in zip(meta["named_opt"].items(), seeds[np_:]))
 
+    events = auditor.events
+    overlap = classify_overlap(events, auditor.scopes, topo,
+                               scheduled=auditor.saw_prefetch_marker)
+
     findings = auditor.findings
+    if not auditor.saw_prefetch_marker:
+        # RLT305 exposed-collective-in-scan: a per-trip ZeRO weight
+        # gather inside a scanned body with no prefetch schedule.
+        # Hoisted loop-invariant gathers are excluded by comparing the
+        # charged count against the scope's trip count: a hoisted
+        # gather is charged once per walk (fwd+bwd -> count 2), a
+        # per-trip one at least once per trip (e.g. the lm_head gather
+        # in the 512-trip CE chunk scan is hoisted — count 2 << 512 —
+        # and the overlap knob could not hide it anyway).
+        seen_305 = set()
+        for e in events:
+            scope_trips = int(
+                auditor.scopes.get(e.scope, {}).get("trips", 1))
+            if (e.prefetchable and e.kind == "all_gather"
+                    and e.scope is not None and not e.unbounded
+                    and scope_trips > 1 and e.count >= scope_trips):
+                key = (e.source, e.param_path)
+                if key in seen_305:
+                    continue
+                seen_305.add(key)
+                findings.append(Finding(
+                    "RLT305",
+                    f"blocking weight all-gather "
+                    f"({_fmt_bytes(e.wire_bytes).strip()} over "
+                    f"{'x'.join(e.axes)}, x{e.count} trips) sits "
+                    "exposed inside a scanned layer body; its operand "
+                    "is a parameter slice known one trip ahead — "
+                    "enable the sharding plan's overlap knob "
+                    "(FSDP/ShardedMesh(overlap='on')) to hide it "
+                    f"behind the previous layer's compute [at "
+                    f"{e.source}]",
+                    symbol=e.param_path or e.source))
     budget = int(topo.hbm_bytes * (1 - reserve_fraction))
     if peak > budget:
         gib = 1024**3
@@ -1514,7 +1801,8 @@ def audit_step(
     return TraceReport(
         topology=topo,
         mesh_axes={k: v for k, v in sizes.items() if v > 1},
-        collectives=auditor.events,
+        collectives=events,
+        overlap=overlap,
         findings=findings,
         params_bytes_per_device=params_dev,
         opt_bytes_per_device=opt_dev,

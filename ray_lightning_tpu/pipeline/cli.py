@@ -29,7 +29,18 @@ def add_perf_parser(sub) -> None:
                    help="persistent compile cache dir for the "
                         "warm-start legs (default: jax's configured one)")
     p.add_argument("--smoke", action="store_true",
-                   help="gate mode: exit 1 unless pipeline occupancy > 0")
+                   help="gate mode: exit 1 unless pipeline occupancy > 0 "
+                        "AND the collective-overlap leg proves the "
+                        "prefetch schedule (fingerprint in the jaxpr, "
+                        "throttled interleave faster than serial)")
+    p.add_argument("--no-overlap-leg", action="store_true",
+                   help="skip the collective-overlap leg (the static "
+                        "schedule trace + throttled fake-collective "
+                        "interleave demo)")
+    p.add_argument("--overlap-layers", type=int, default=8,
+                   help="layers in the throttled interleave demo")
+    p.add_argument("--overlap-comm-ms", type=float, default=20.0,
+                   help="fake collective latency for the interleave demo")
     # parses into the SAME namespace as the parent --json (see plan_p)
     p.add_argument("--json", action="store_true", dest="as_json",
                    default=argparse.SUPPRESS)
@@ -44,10 +55,46 @@ def run_perf(args) -> int:
         delay_s=(args.delay_ms / 1e3 if args.delay_ms is not None else None),
         cache_dir=args.cache_dir,
     )
+    if not args.no_overlap_leg:
+        from ray_lightning_tpu.pipeline.collective_overlap import (
+            measure_collective_overlap,
+        )
+
+        try:
+            result.update(measure_collective_overlap(
+                n_layers=args.overlap_layers,
+                t_comm_s=args.overlap_comm_ms / 1e3))
+        except Exception as exc:  # noqa: BLE001 — an analysis bug must
+            # not cost the CLI the prefetch/occupancy evidence it
+            # already measured: emit the structured line with the
+            # failure named, and let --smoke fail on the verdict below
+            result["overlap_error"] = (
+                f"{type(exc).__name__}: {str(exc)[:200]}")
+            result["overlap_schedule_ok"] = False
     print(json.dumps(result), flush=True)
     if args.smoke and result["pipeline_occupancy"] <= 0.0:
         print("perf smoke FAILED: prefetch pipeline occupancy is 0 — the "
               "prefetcher never had a batch resident ahead of the step",
               file=sys.stderr)
         return 1
+    if args.smoke and not args.no_overlap_leg:
+        if not result.get("overlap_schedule_ok"):
+            print("perf smoke FAILED: the collective-overlap schedule "
+                  "did not verify (prefetch fingerprint missing, or the "
+                  "off-trace failed to flag exposed gathers — see "
+                  "overlap_trace)", file=sys.stderr)
+            return 1
+        # the floor scales with the demo's own roofline so tuning
+        # --overlap-comm-ms/--overlap-layers cannot make a perfectly
+        # interleaved schedule fail: demand half the ideal gain, capped
+        # at the 1.15 the 20ms/20ms default comfortably clears
+        floor = min(1.15, 1 + 0.5 * (result.get("ideal_speedup", 1.3) - 1))
+        if result.get("overlap_speedup", 0.0) < floor:
+            print(f"perf smoke FAILED: throttled interleave demo shows "
+                  f"no latency hiding (speedup "
+                  f"{result.get('overlap_speedup')} < floor "
+                  f"{floor:.3f}; serial {result.get('serial_s')}s vs "
+                  f"overlapped {result.get('overlapped_s')}s)",
+                  file=sys.stderr)
+            return 1
     return 0

@@ -1,0 +1,166 @@
+"""CPU-runnable evidence for the collective-overlap schedule (ISSUE 6).
+
+No accelerator can MEASURE collective/compute overlap on this box (XLA's
+CPU backend runs collectives synchronously), so the perf CLI's overlap
+leg proves the schedule two ways, both honest about what they are:
+
+  1. **Static proof on the real model** (`trace_overlap_schedule`): the
+     tiny scanned Llama step is traced with the strategy's
+     ``overlap="on"`` knob and audited by tracecheck. The assertion is
+     structural — the jaxpr carries the double-buffer fingerprint
+     (`ops.dispatch.OVERLAP_PREFETCH_NAME`) and the per-trip prefetch
+     gathers are classified against the compute window — i.e. the
+     program the TPU would run IS the prefetch schedule.
+
+  2. **Throttled interleave demo** (`simulate_overlap_schedule`): the
+     same double-buffer discipline executed on the host with a fake
+     collective (a timed sleep on a background thread, standing in for
+     the DMA engine that runs a real TPU all-gather) against real
+     matmul compute. The serial schedule pays gather+compute per layer;
+     the double-buffered schedule pays max(gather, compute) per layer
+     after the prologue — the measured speedup converging to
+     ``(t_g + t_c) / max(t_g, t_c)`` is the latency-hiding claim of
+     docs/PERFORMANCE.md "collective overlap", demonstrated end to end.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict
+
+__all__ = ["trace_overlap_schedule", "simulate_overlap_schedule",
+           "measure_collective_overlap"]
+
+
+def trace_overlap_schedule(n_devices: int = 8) -> Dict[str, Any]:
+    """tracecheck the tiny scanned Llama under ``overlap="on"`` vs
+    ``"off"`` on an abstract ``v5e-<n>`` FSDP slice (zero devices
+    touched). Returns the structural verdict: the on-trace must carry
+    the prefetch fingerprint, the off-trace must not (and must flag the
+    exposed gathers as RLT305)."""
+    import numpy as np
+
+    from ray_lightning_tpu.analysis.costmodel import topology_for_kind
+    from ray_lightning_tpu.analysis.tracecheck import audit_step
+    from ray_lightning_tpu.models.llama import LlamaConfig, LlamaModule
+    from ray_lightning_tpu.parallel.strategy import ShardedMesh
+
+    # big enough that the compute window is non-trivial against the ICI
+    # model, small enough to trace in seconds
+    cfg = LlamaConfig.tiny(dim=256, n_layers=4, n_heads=8, n_kv_heads=4,
+                           hidden_dim=1024, max_seq_len=512)
+    batch = {"tokens": np.zeros((n_devices, 513), np.int32)}
+    topo = topology_for_kind("TPU v5e", n_devices)
+
+    def _audit(overlap: str):
+        return audit_step(
+            LlamaModule(cfg), ShardedMesh(fsdp=n_devices, overlap=overlap),
+            batch, topology=topo, label=f"perf overlap={overlap}")
+
+    on, off = _audit("on"), _audit("off")
+    on_ov, off_ov = on.overlap or {}, off.overlap or {}
+    return {
+        "scheduled": bool(on_ov.get("scheduled")),
+        "off_scheduled": bool(off_ov.get("scheduled")),
+        "hidden_fraction_on": round(on.overlap_hidden_fraction, 4),
+        "hidden_fraction_off": round(off.overlap_hidden_fraction, 4),
+        "exposed_findings_off": sum(
+            1 for f in off.findings if f.rule == "RLT305"),
+        "per_scope_on": on_ov.get("per_scope", []),
+    }
+
+
+def simulate_overlap_schedule(
+    n_layers: int = 8,
+    t_comm_s: float = 0.02,
+    compute_ms_target: float = 20.0,
+) -> Dict[str, Any]:
+    """Execute the double-buffer discipline on the host: a throttled
+    fake collective (sleep on a worker thread — the stand-in for a DMA
+    engine) against real numpy-on-jax matmul compute.
+
+    serial:      for i: gather(i); compute(i)
+    overlapped:  gather(0); for i: start gather(i+1); compute(i); join
+
+    Returns measured wall times and the speedup; ``ideal_speedup`` is
+    the roofline ``(t_g + t_c) / max(t_g, t_c)`` the schedule converges
+    to as n_layers grows (the prologue gather amortizes away).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    # calibrate a matmul whose wall time approximates the target
+    n = 256
+    x = jnp.ones((n, n), jnp.float32)
+    f = jax.jit(lambda a: a @ a)
+    f(x).block_until_ready()
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        f(x).block_until_ready()
+    per = (time.perf_counter() - t0) / reps
+    loops = max(1, int((compute_ms_target / 1e3) / max(per, 1e-6)))
+
+    def compute():
+        for _ in range(loops):
+            f(x).block_until_ready()
+
+    def fake_gather():
+        time.sleep(t_comm_s)
+
+    # measured per-layer compute (for the roofline denominator)
+    t0 = time.perf_counter()
+    compute()
+    t_c = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _ in range(n_layers):
+        fake_gather()
+        compute()
+    serial_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    fake_gather()  # prologue: layer 0's exposed gather
+    for i in range(n_layers):
+        th = None
+        if i + 1 < n_layers:
+            th = threading.Thread(target=fake_gather)
+            th.start()  # issue layer i+1's gather BEFORE layer i's compute
+        compute()
+        if th is not None:
+            th.join()  # the double buffer is ready when the trip ends
+    overlapped_s = time.perf_counter() - t0
+
+    ideal = (t_comm_s + t_c) / max(t_comm_s, t_c)
+    return {
+        "n_layers": n_layers,
+        "t_comm_ms": round(t_comm_s * 1e3, 2),
+        "t_compute_ms": round(t_c * 1e3, 2),
+        "serial_s": round(serial_s, 4),
+        "overlapped_s": round(overlapped_s, 4),
+        "overlap_speedup": round(serial_s / max(overlapped_s, 1e-9), 3),
+        "ideal_speedup": round(ideal, 3),
+    }
+
+
+def measure_collective_overlap(
+    n_layers: int = 8,
+    t_comm_s: float = 0.02,
+    trace_devices: int = 8,
+) -> Dict[str, Any]:
+    """The perf CLI's overlap leg: static schedule proof + throttled
+    interleave demo, one dict (keys prefixed for the perf JSON line)."""
+    out: Dict[str, Any] = {}
+    trace = trace_overlap_schedule(n_devices=trace_devices)
+    out["overlap_trace"] = trace
+    out.update(simulate_overlap_schedule(
+        n_layers=n_layers, t_comm_s=t_comm_s))
+    # strict >: the off-trace hides nothing (0.0), so this doubles as a
+    # hidden_fraction_on > 0 check — a classification pass that silently
+    # stops counting compute (and so hides nothing) must fail the leg,
+    # not vacuously tie the off schedule
+    out["overlap_schedule_ok"] = bool(
+        trace["scheduled"] and not trace["off_scheduled"]
+        and trace["exposed_findings_off"] > 0
+        and trace["hidden_fraction_on"] > trace["hidden_fraction_off"])
+    return out

@@ -1,0 +1,229 @@
+#!/usr/bin/env python
+"""Bench regression ratchet (ISSUE 6 satellite): a fresh bench JSON line
+must not regress the best prior round.
+
+Prior rounds are the checked-in ``BENCH_r0*.json`` recorder wrappers
+(each holds the round's parsed bench line under ``"parsed"``; rounds the
+backend skipped contribute nothing). For every ratcheted metric the best
+prior value is the per-metric max — speed can only go up:
+
+    value                    tokens/sec/chip (the headline metric)
+    mfu                      model FLOPs utilization
+    overlap_hidden_fraction  hidden share of prefetchable ICI time
+                             (static, carried even on skip lines)
+
+Gate semantics:
+
+  * fresh line with ``"skipped"`` — an environmental skip (backend
+    down, driver kill). The MEASURED metrics are waived: the ratchet
+    gates merit, not machine availability. The STATIC metrics
+    (overlap_hidden_fraction — computed without hardware and carried
+    on the skip line) still ratchet when present. The BENCH_r05
+    regression class (rc=124, no JSON) FAILS — there is no line to
+    pass.
+  * fresh success line — every ratcheted metric present in both the
+    fresh line and some prior round must satisfy
+    ``fresh >= best_prior * (1 - tolerance)`` (default 5%, --tolerance).
+    A metric the priors track but the fresh line DROPPED also fails:
+    deleting the field must not bypass the ratchet.
+
+Usage:
+    python scripts/bench_gate.py fresh.json          # wrapper or raw line
+    ... | python scripts/bench_gate.py -             # last JSON line wins
+    python scripts/bench_gate.py fresh.json --prior-glob 'BENCH_r0*.json'
+
+Exit 0 pass, 1 regression, 2 invalid input (unparseable fresh line).
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Optional
+
+#: metric name -> key in the bench JSON line. "value" is
+#: tokens/sec/chip (see the line's "metric"/"unit" fields).
+RATCHETED = {
+    "tokens_per_sec_per_chip": "value",
+    "mfu": "mfu",
+    "overlap_hidden_fraction": "overlap_hidden_fraction",
+}
+
+#: keys computed by static analysis (no hardware needed) — carried on
+#: backend-down skip lines and ratcheted there too, unlike measurements
+STATIC = {"overlap_hidden_fraction"}
+
+
+def _extract_line(obj: dict) -> Optional[dict]:
+    """A recorder wrapper ({"parsed": {...}}) or a raw bench line."""
+    if not isinstance(obj, dict):
+        return None
+    if "parsed" in obj:
+        parsed = obj["parsed"]
+        return parsed if isinstance(parsed, dict) else None
+    return obj if "metric" in obj else None
+
+
+def _last_json_line(text: str) -> Optional[dict]:
+    """The LAST parseable JSON object line — bench.py's contract is that
+    its final stdout line is the structured one (watchdog/kill lines
+    close any half-written line first)."""
+    for raw in reversed(text.strip().splitlines()):
+        raw = raw.strip()
+        if not raw.startswith("{"):
+            continue
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError:
+            continue
+    return None
+
+
+def best_prior(prior_glob: str, repo_root: str) -> dict:
+    """Per-metric max over all prior rounds that measured it. A skip
+    round's static fields (e.g. overlap_hidden_fraction on a
+    backend-down line) still ratchet: they were honestly computed."""
+    best: dict = {}
+    for path in sorted(glob.glob(os.path.join(repo_root, prior_glob))):
+        try:
+            with open(path) as f:
+                line = _extract_line(json.load(f))
+        except (OSError, json.JSONDecodeError):
+            continue
+        if line is None:
+            continue
+        try:
+            measured = ("skipped" not in line
+                        and float(line.get("value") or 0) > 0)
+        except (TypeError, ValueError):  # "value": null / non-numeric
+            measured = False
+        for name, key in RATCHETED.items():
+            # value/mfu are measurements — only success lines count;
+            # overlap_hidden_fraction is static analysis — any line
+            v = line.get(key)
+            if v is None or (key != "overlap_hidden_fraction"
+                             and not measured):
+                continue
+            try:
+                v = float(v)
+            except (TypeError, ValueError):
+                continue
+            if name not in best or v > best[name][0]:
+                best[name] = (v, os.path.basename(path))
+    return best
+
+
+def gate(fresh: dict, best: dict, tolerance: float) -> list[str]:
+    """Return the list of failure messages (empty = pass)."""
+    skipped = "skipped" in fresh
+    if skipped and "metric" not in fresh:
+        return ["skip line is not the structured schema "
+                "(missing 'metric')"]
+    failures = []
+    for name, key in RATCHETED.items():
+        if skipped:
+            # an environmental skip waives only the MEASURED metrics;
+            # the static ones (overlap_hidden_fraction) are computed
+            # without hardware, carried on the skip line, and still
+            # ratchet when present. Absent on a skip line passes — an
+            # analysis error (the line carries overlap_error instead)
+            # must not masquerade as a regression.
+            if key not in STATIC or fresh.get(key) is None:
+                continue
+        if name not in best:
+            continue
+        prior, source = best[name]
+        if prior <= 0:
+            continue
+        v = fresh.get(key)
+        if v is None:
+            if key in STATIC and ("overlap_error" in fresh
+                                  or "tracecheck_error" in fresh):
+                # bench.py's contract: a static-analysis bug is reported
+                # as overlap_error (or tracecheck_error when the whole
+                # trace died) and must never cost perf evidence — that
+                # is an analysis failure, not a deleted field
+                continue
+            failures.append(
+                f"{name}: prior rounds track it ({prior:g} in {source}) "
+                f"but the fresh line dropped the field '{key}'")
+            continue
+        try:
+            v = float(v)
+        except (TypeError, ValueError):
+            failures.append(f"{name}: non-numeric value {v!r}")
+            continue
+        floor = prior * (1 - tolerance)
+        if v < floor:
+            failures.append(
+                f"{name}: {v:g} regressed below {floor:g} "
+                f"(best prior {prior:g} in {source}, "
+                f"tolerance {tolerance:.0%})")
+    return failures
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        "bench_gate", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("fresh",
+                   help="fresh bench JSON (wrapper or raw line); '-' "
+                        "reads stdin and takes the last JSON line")
+    p.add_argument("--prior-glob", default="BENCH_r0*.json",
+                   help="prior-round files, relative to --repo-root")
+    p.add_argument("--repo-root",
+                   default=os.path.dirname(os.path.dirname(
+                       os.path.abspath(__file__))))
+    p.add_argument("--tolerance", type=float,
+                   default=float(os.environ.get("RLT_BENCH_GATE_TOL",
+                                                0.05)),
+                   help="allowed per-metric regression (default 0.05)")
+    args = p.parse_args(argv)
+
+    if args.fresh == "-":
+        fresh = _last_json_line(sys.stdin.read())
+    else:
+        try:
+            with open(args.fresh) as f:
+                text = f.read()
+        except OSError as exc:
+            print(f"bench_gate: cannot read {args.fresh}: {exc}",
+                  file=sys.stderr)
+            return 2
+        try:
+            fresh = _extract_line(json.loads(text))
+        except json.JSONDecodeError:
+            fresh = _last_json_line(text)
+    if fresh is None:
+        print("bench_gate: no parseable bench JSON line in input — "
+              "this is the BENCH_r05 failure class (unparseable round), "
+              "failing", file=sys.stderr)
+        return 2
+
+    best = best_prior(args.prior_glob, args.repo_root)
+    failures = gate(fresh, best, args.tolerance)
+    if failures:
+        for msg in failures:
+            print(f"bench_gate: REGRESSION — {msg}", file=sys.stderr)
+        return 1
+    if "skipped" in fresh:
+        checked = ", ".join(
+            f"{name}={float(fresh[key]):g} (best {best[name][0]:g})"
+            for name, key in RATCHETED.items()
+            if key in STATIC and name in best
+            and fresh.get(key) is not None)
+        print(f"bench_gate: pass (environmental skip: {fresh['skipped']}; "
+              f"static ratchet: {checked or 'not exercised'})")
+    else:
+        checked = ", ".join(
+            f"{name}={float(fresh[key]):g} (best {best[name][0]:g})"
+            for name, key in RATCHETED.items()
+            if name in best and fresh.get(key) is not None)
+        print(f"bench_gate: pass — {checked or 'no prior metrics'}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

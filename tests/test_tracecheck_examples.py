@@ -74,7 +74,15 @@ def test_trace_cli_json_llama(tmp_path):
     assert d["ici_bytes_per_step"] > 0
     assert d["peak_hbm_bytes"] > 0
     assert d["fits"] is True
-    assert d["findings"] == []
+    # the un-overlapped ZeRO scan legitimately draws RLT305 advisories
+    # (exposed per-trip weight gathers — the overlap knob's pointer);
+    # anything else is a regression
+    assert all(f["rule"] == "RLT305" for f in d["findings"]), d["findings"]
+    # ...but only for PER-TRIP gathers: the lm_head gather is
+    # loop-invariant in the CE chunk scan and hoisted — the knob could
+    # not hide it, so flagging it would be a false advisory
+    assert not any("lm_head" in (f.get("symbol") or "")
+                   for f in d["findings"]), d["findings"]
 
 
 def test_trace_cli_unknown_target_exits_2():
