@@ -79,6 +79,16 @@ print(f"overlap gate: {frac:.0%} of prefetchable ICI time hidden")'
 # "fault-injection cookbook".
 JAX_PLATFORMS=cpu python -m ray_lightning_tpu supervise --smoke > /dev/null
 
+# observability gate (docs/OBSERVABILITY.md): telemetry=off must train
+# bitwise-identically and lower a byte-identical step program; a 2-proc
+# CPU-SPMD supervised run with an injected worker kill must produce a
+# parseable goodput report whose buckets sum to supervised wall time
+# (±5%) with the backoff + replay lost-time classes nonzero; and the
+# flagship llama3-8b drift section must emit (structured-skip measured
+# placeholder on a box with no TPU) against tracecheck's predicted step
+# composition.
+JAX_PLATFORMS=cpu python -m ray_lightning_tpu monitor --smoke > /dev/null
+
 # prefetch-overlap + collective-overlap smoke: a slow-loader CPU run
 # must show pipeline occupancy > 0 (the device prefetcher demonstrably
 # kept batches resident ahead of the step), the overlap jaxpr must

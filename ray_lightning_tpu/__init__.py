@@ -48,6 +48,8 @@ from ray_lightning_tpu.resilience import (
     fit_supervised,
     supervise,
 )
+from ray_lightning_tpu import telemetry
+from ray_lightning_tpu.telemetry import ProfileConfig, TelemetryConfig
 
 __version__ = "0.1.0"
 
@@ -87,5 +89,8 @@ __all__ = [
     "SupervisedResult",
     "fit_supervised",
     "supervise",
+    "telemetry",
+    "TelemetryConfig",
+    "ProfileConfig",
     "__version__",
 ]

@@ -48,6 +48,19 @@ injected parameter bit-flip must quarantine the rank):
     python -m ray_lightning_tpu supervise my_project.jobs:make_job \\
         --processes 4 --max-restarts 3
 
+``report`` / ``monitor`` read the telemetry a run left behind
+(telemetry/, docs/OBSERVABILITY.md): the goodput classification of
+supervised wall time, per-rank span timelines, and — with
+``--preset/--topo`` — the drift section joining the measured timeline
+against tracecheck's prediction. ``monitor --smoke`` is the format.sh
+observability gate (telemetry=off byte-identical pin, fault-injected
+goodput report sums to wall, flagship drift section emits):
+
+    python -m ray_lightning_tpu report rlt_logs --preset llama3-8b \\
+        --topo v5p-64
+    python -m ray_lightning_tpu monitor rlt_logs --follow
+    python -m ray_lightning_tpu monitor --smoke
+
 Exit status: 0 when the plan fits, 1 when it does not, 2 when the
 configuration is invalid (e.g. a global batch not divisible by the
 data-parallel degree — refused rather than planned wrong; the error goes
@@ -394,11 +407,16 @@ def main(argv=None) -> int:
     from ray_lightning_tpu.resilience.cli import (
         add_supervise_parser, run_supervise,
     )
+    from ray_lightning_tpu.telemetry.report import (
+        add_monitor_parser, add_report_parser, run_monitor, run_report,
+    )
 
     add_lint_parser(sub)
     add_trace_parser(sub)
     add_supervise_parser(sub)
     add_perf_parser(sub)
+    add_report_parser(sub)
+    add_monitor_parser(sub)
     args = p.parse_args(argv)
     if args.cmd == "plan":
         return run_plan(args)
@@ -410,6 +428,10 @@ def main(argv=None) -> int:
         return run_supervise(args)
     if args.cmd == "perf":
         return run_perf(args)
+    if args.cmd == "report":
+        return run_report(args)
+    if args.cmd == "monitor":
+        return run_monitor(args)
     info = collect(probe=args.probe)
     if args.as_json:
         print(json.dumps(info))

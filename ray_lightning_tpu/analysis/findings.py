@@ -132,6 +132,17 @@ RULES: Dict[str, Rule] = {r.id: r for r in (
          "looks like success), or a started WorkerGroup has no "
          "shutdown() in a finally / context manager (a failure leaks "
          "worker processes and their hosts' chips)"),
+    # RLT5xx — telemetry/observability misuse (docs/OBSERVABILITY.md):
+    # instrumentation that itself becomes the overhead it measures.
+    Rule("RLT501", "telemetry-misuse", "warning",
+         "telemetry emission (TelemetryRecorder span/record/flush, "
+         "profiler start/stop) inside a per-batch loop without a "
+         "cadence guard — per-step file flushes/captures stall the hot "
+         "loop the spans exist to measure (buffer in the bounded ring, "
+         "flush on `if step %% N == 0`) — or an unbounded event-list "
+         "append in a per-batch Callback hook with no ring/truncation/"
+         "flush anywhere in the class (the list grows for the life of "
+         "the run; use a deque(maxlen=...) or truncate)"),
 )}
 
 

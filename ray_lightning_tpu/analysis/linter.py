@@ -656,6 +656,36 @@ def _loop_step_outputs(loop: ast.For) -> Set[str]:
     return outs
 
 
+#: method names that emit/persist telemetry when called on a
+#: telemetry-shaped receiver (RLT501 arm A)
+_TELEMETRY_METHODS: Set[str] = {
+    "span", "record", "emit", "flush", "start_trace", "stop_trace",
+}
+
+#: receiver-name tokens that mark an object as telemetry machinery
+_TELEMETRY_TOKENS: Tuple[str, ...] = (
+    "telemetry", "recorder", "tracer", "profiler", "span",
+)
+
+
+def _telemetry_call(node: ast.Call) -> Optional[str]:
+    """A human-readable description when this call is telemetry emission
+    (RLT501 arm A), else None."""
+    if isinstance(node.func, ast.Attribute):
+        meth = node.func.attr
+        if meth not in _TELEMETRY_METHODS:
+            return None
+        recv = _dotted(node.func.value) or ""
+        low = recv.lower()
+        if any(tok in low for tok in _TELEMETRY_TOKENS):
+            return f"{recv}.{meth}"
+        return None
+    fname = _dotted(node.func) or ""
+    if fname.split(".")[-1] in ("record_span", "emit_span"):
+        return fname
+    return None
+
+
 def _lint_hot_loop(lint: _FileLint, loop: ast.For,
                    symbol: Optional[str]) -> None:
     step_outputs = _loop_step_outputs(loop)
@@ -668,6 +698,19 @@ def _lint_hot_loop(lint: _FileLint, loop: ast.For,
         if not isinstance(node, ast.Call):
             continue
         if _under_cadence_guard(node, parents):
+            continue
+        tele = _telemetry_call(node)
+        if tele is not None:
+            lint.add(
+                "RLT501",
+                f"{tele}() inside the per-batch loop outside a cadence "
+                "guard — hand-rolled per-step telemetry puts flushes/"
+                "captures (and whatever backs this recorder) on the hot "
+                "path it exists to measure. Use the trainer's built-in "
+                "instrumentation (Trainer(telemetry=...) already spans "
+                "these seams from a bounded ring), or guard the call "
+                "with the log cadence (if step % N == 0) "
+                "(docs/OBSERVABILITY.md)", node, symbol)
             continue
         fname = _dotted(node.func)
         if fname is not None and fname.split(".")[-1] == "device_put":
@@ -926,6 +969,99 @@ class _ResilienceLint(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+# ---- RLT501 arm B: unbounded event accumulation in callback code ----------
+
+#: the hooks that run once per batch — an unbounded append here grows
+#: for the life of the run
+_BATCH_HOOKS: Tuple[str, ...] = (
+    "on_train_batch_start", "on_train_batch_end",
+)
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'X' for ``self.X`` (through a subscript: ``self.X[0]``)."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+class _TelemetryCallbackLint:
+    """RLT501 arm B: ``self.X.append(...)`` in a per-batch Callback hook
+    where nothing in the class ever bounds X — no deque(maxlen=...)
+    construction, no reassignment/truncation outside __init__, no
+    clear/pop. The sanctioned shapes (ThroughputMonitor's
+    ``self._times = self._times[-window:]``, a ring deque, an explicit
+    flush-and-clear) all leave bounding evidence the scan accepts."""
+
+    def __init__(self, lint: _FileLint):
+        self.lint = lint
+
+    @staticmethod
+    def _is_callback(cls: ast.ClassDef) -> bool:
+        for base in cls.bases:
+            name = _dotted(base) or ""
+            if name.split(".")[-1].endswith("Callback"):
+                return True
+        return False
+
+    def run(self, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef) and self._is_callback(node):
+                self._scan_class(node)
+
+    def _scan_class(self, cls: ast.ClassDef) -> None:
+        bounded: Set[str] = set()
+        appends: List[Tuple[str, ast.Call]] = []
+        for item in cls.body:
+            if not isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            for node in ast.walk(item):
+                if isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        attr = _self_attr(tgt)
+                        if attr is None:
+                            continue
+                        if item.name != "__init__":
+                            # truncation / replacement in a hook body
+                            bounded.add(attr)
+                        elif (isinstance(node.value, ast.Call)
+                              and (_dotted(node.value.func) or ""
+                                   ).split(".")[-1] == "deque"):
+                            bounded.add(attr)  # ring from birth
+                elif isinstance(node, ast.Delete):
+                    for tgt in node.targets:
+                        attr = _self_attr(tgt)
+                        if attr is not None:
+                            bounded.add(attr)
+                elif (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)):
+                    attr = _self_attr(node.func.value)
+                    if attr is None:
+                        continue
+                    if node.func.attr in ("clear", "pop", "popleft"):
+                        bounded.add(attr)
+                    elif (node.func.attr == "append"
+                            and item.name in _BATCH_HOOKS):
+                        appends.append((attr, node))
+        for attr, call in appends:
+            if attr in bounded:
+                continue
+            self.lint.add(
+                "RLT501",
+                f"self.{attr}.append(...) in a per-batch callback hook "
+                f"with no bound in class {cls.name!r} (no deque(maxlen), "
+                "no truncation/clear/pop anywhere) — the list grows for "
+                "the life of the run; buffer in a bounded ring "
+                "(collections.deque(maxlen=N) or truncate on append) "
+                "and flush on a cadence (docs/OBSERVABILITY.md)",
+                call, cls.name)
+
+
 def lint_source(source: str, filename: str = "<string>",
                 extra_axes: Sequence[str] = ()) -> List[Finding]:
     """Lint one file's source text. Never imports the target."""
@@ -983,6 +1119,7 @@ def lint_source(source: str, filename: str = "<string>",
     # RLT304 needs the FINAL traced set: hot-loop rules fire only in
     # non-traced code (a loop under a tracer is RLT201's scope)
     _HotLoopLint(lint).run(tree, coll.funcs)
+    _TelemetryCallbackLint(lint).run(tree)
     return lint.findings
 
 

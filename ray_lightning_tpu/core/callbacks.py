@@ -246,21 +246,43 @@ class ModelCheckpoint(Callback):
 
 class ThroughputMonitor(Callback):
     """Step-time / examples-per-sec — the §5.5 gap in the reference (it had
-    no system metrics at all). Feeds trainer.callback_metrics."""
+    no system metrics at all). Feeds trainer.callback_metrics.
 
-    def __init__(self, window: int = 20):
+    Cold-compile skew: without AOT warm start (``warm_start=False``, or
+    a shape drift re-trace) the FIRST measured interval contains the
+    lazy XLA compile — seconds against millisecond steps — and a
+    window-mean over it misreports steps/s for the next ``window``
+    batches. The first ``skip_first`` intervals of each fit are dropped,
+    so the reported window is warm-only, consistent with the telemetry
+    timeline's warm-step stats (telemetry/report.py drops the cold step
+    the same way). ``clock`` is injectable for deterministic tests."""
+
+    def __init__(self, window: int = 20, skip_first: int = 1,
+                 clock=None):
         self.window = window
+        self.skip_first = max(0, skip_first)
+        self._clock = clock or time.perf_counter
         self._times: list[float] = []
         self._t0: Optional[float] = None
+        self._intervals_seen = 0
+
+    def on_fit_start(self, trainer, module) -> None:
+        # a resumed/re-fit trainer re-pays its (possibly lazy) compile:
+        # the skip window re-arms per fit, not per construction
+        self._times = []
+        self._t0 = None
+        self._intervals_seen = 0
 
     def on_train_epoch_start(self, trainer, module) -> None:
-        self._t0 = time.perf_counter()
+        self._t0 = self._clock()
 
     def on_train_batch_end(self, trainer, module, metrics, batch_idx) -> None:
-        t = time.perf_counter()
+        t = self._clock()
         if self._t0 is not None:
-            self._times.append(t - self._t0)
-            self._times = self._times[-self.window:]
+            self._intervals_seen += 1
+            if self._intervals_seen > self.skip_first:
+                self._times.append(t - self._t0)
+                self._times = self._times[-self.window:]
         self._t0 = t
         if self._times:
             step_time = float(np.mean(self._times))

@@ -55,6 +55,21 @@ from ray_lightning_tpu.pipeline.prefetch import (
     DevicePrefetcher,
     prefetch_to_device,
 )
+from ray_lightning_tpu.telemetry import TelemetryConfig
+from ray_lightning_tpu.telemetry import goodput as _goodput
+from ray_lightning_tpu.telemetry.profiler import (
+    ProfileConfig,
+    ProfilerController,
+)
+from ray_lightning_tpu.telemetry.spans import (
+    NULL_RECORDER,
+    PH_CKPT,
+    PH_DISPATCH,
+    PH_EVAL,
+    PH_METRICS,
+    PH_STEP,
+    TelemetryRecorder,
+)
 from ray_lightning_tpu.utils import get_logger, seed_everything
 
 log = get_logger(__name__)
@@ -86,6 +101,8 @@ class Trainer:
         warm_start: bool = True,
         compile_cache_dir: Optional[str] = None,
         guard: Any = None,
+        telemetry: Any = None,
+        profile: Any = None,
     ):
         self.strategy = strategy or SingleDevice()
         self.max_epochs = max_epochs
@@ -132,6 +149,22 @@ class Trainer:
         #: it. Applied in _init_state when the restore point is behind
         #: the marker's detection step.
         self.resume_skip_past: Optional[Dict[str, Any]] = None
+        #: telemetry (telemetry/, docs/OBSERVABILITY.md): True /
+        #: TelemetryConfig arms the host-side span recorder — data wait,
+        #: H2D, dispatch, metric fetch, ckpt stall, compile, eval spans
+        #: into a bounded ring flushed as per-rank JSONL on the logging
+        #: cadence. Host bookkeeping only: telemetry=off compiles the
+        #: byte-identical device program (test-pinned).
+        self.telemetry = telemetry
+        #: on-demand jax.profiler capture (telemetry/profiler.py):
+        #: ProfileConfig(step window / marker file / SIGUSR1), rank-scoped
+        self.profile = profile
+        self.telemetry_recorder = NULL_RECORDER
+        self._profiler: Optional[ProfilerController] = None
+        self._telemetry_flush_every = 50
+        self._fit_start_perf: Optional[float] = None
+        self._fit_start_step = 0
+        self._launch_s = 0.0
 
         self.callbacks: List[Callback] = list(callbacks or [])
         if enable_checkpointing and not any(
@@ -201,6 +234,7 @@ class Trainer:
         # mesh first: configure_model may close over it (ring attention).
         self.strategy.setup(module)
         module.setup()
+        self._setup_telemetry()
 
         if datamodule is not None:
             datamodule.setup()
@@ -220,13 +254,18 @@ class Trainer:
         self.state = self._init_state(module, example_batch, ckpt_path)
         self._train_step = self._make_train_step(module)
         self._eval_step = self._make_eval_step(module, module.validation_step)
-        if self.warm_start:
-            self._warm_start_train_step(example_batch)
+        self._fit_start_perf = time.perf_counter()
+        self._fit_start_step = self.global_step
 
         module.on_fit_start(self)
         self._invoke("on_fit_start")
         fit_error: Optional[BaseException] = None
         try:
+            # warm start AFTER on_fit_start: the heartbeat sender is now
+            # running, so a long AOT compile reports itself as a live
+            # "compile" span instead of a silent pre-loop stall
+            if self.warm_start:
+                self._warm_start_train_step(example_batch)
             if self.num_sanity_val_steps and self.has_validation:
                 self._run_eval_epoch(
                     val_dataloaders, limit=self.num_sanity_val_steps, sanity=True
@@ -251,6 +290,9 @@ class Trainer:
             # Parity C5: the driver-side module object holds trained weights.
             if self.state is not None:
                 module.params = self.state.params
+            if self._profiler is not None:
+                self._profiler.close()
+            self._finalize_telemetry(completed=fit_error is None)
         module.on_fit_end(self)
         self._invoke("on_fit_end")
         self.is_fitted = True
@@ -307,8 +349,11 @@ class Trainer:
         # already advanced the raw iterator, so a mid-epoch resume never
         # pays placement for batches it will drop. Order is preserved —
         # training is bitwise-identical to the synchronous path.
+        rec = self.telemetry_recorder
+        t_prev: Optional[float] = None
         stream = prefetch_to_device(
-            it, self._place_train_batch, depth=self.prefetch_to_device)
+            it, self._place_train_batch, depth=self.prefetch_to_device,
+            recorder=rec)
         try:
             # start=skip: callbacks must see the true intra-epoch batch
             # index after a mid-epoch resume
@@ -327,17 +372,39 @@ class Trainer:
                 self.last_batch_size = bs
                 device_batch = self._invoke_batch_start(
                     device_batch, batch_idx)
-                self.state, metrics = self._train_step(
-                    self.state, device_batch, self._base_rng
-                )
+                rec.set_step(self.global_step)
+                with rec.span(PH_DISPATCH, step=self.global_step):
+                    self.state, metrics = self._train_step(
+                        self.state, device_batch, self._base_rng
+                    )
                 self.global_step += 1
                 self._epoch_batches_done += 1
+                if rec.enabled:
+                    # per-step host wall (batch boundary to batch
+                    # boundary) — the measured side of the drift report
+                    t_now = time.perf_counter()
+                    if t_prev is not None:
+                        rec.record(PH_STEP, t_prev, t_now - t_prev,
+                                   step=self.global_step)
+                    t_prev = t_now
+                if self._profiler is not None:
+                    self._profiler.on_step(self.global_step)
                 pending = metrics
                 # Lazy metric fetch: only sync on the logging cadence.
                 if self.global_step % max(1, self.log_every_n_steps) == 0:
-                    host = _to_host(metrics)
+                    with rec.span(PH_METRICS, step=self.global_step):
+                        host = _to_host(metrics)
                     self.callback_metrics.update(host)
                     pending = host
+                # telemetry persistence on its own configured cadence
+                # (TelemetryConfig.flush_every_n_steps): the ring drains
+                # to JSONL and the goodput ledger refreshes, so a killed
+                # worker leaves an almost-current account of where its
+                # wall went even under a sparse logging cadence
+                if (rec.enabled and self.global_step
+                        % self._telemetry_flush_every == 0):
+                    rec.flush()
+                    self._write_telemetry_ledger(completed=False)
                 self._invoke("on_train_batch_end", pending, batch_idx)
                 if (self.val_check_interval and self.has_validation
                         and val_loader is not None
@@ -379,30 +446,40 @@ class Trainer:
             loader.set_epoch(self.current_epoch)
         totals: Dict[str, Any] = {}
         weights = 0.0
-        stream = prefetch_to_device(
-            loader, self._place_eval_batch, depth=self.prefetch_to_device)
-        try:
-            for batch_idx, (bs, device_batch) in enumerate(stream):
-                if limit is not None and batch_idx >= limit:
-                    break
-                metrics = self._eval_step(self.state.params, device_batch)
-                for k, v in metrics.items():
-                    # accumulate in f32 — a bf16 step metric summed over
-                    # hundreds of batches would round away the increments
-                    scaled = jnp.asarray(v).astype(jnp.float32) * bs
-                    totals[k] = totals[k] + scaled if k in totals else scaled
-                weights += bs
-        finally:
-            if isinstance(stream, DevicePrefetcher):
-                stream.close()
-        if (isinstance(self._eval_step, WarmStep)
-                and self._eval_step.stats.total_s):
-            self.callback_metrics.update(
-                self._eval_step.stats.to_metrics("val_"))
-        if sanity or weights == 0:
-            return {}
-        host = _to_host(totals)
-        return {k: float(v) / weights for k, v in host.items()}
+        with self.telemetry_recorder.span(PH_EVAL):
+            # recorder here too: an eval epoch starved on its loader
+            # shows as itemized data_wait, not as opaque "eval" time
+            # (the recorder credits the enclosing eval span, so the
+            # buckets never double-count)
+            stream = prefetch_to_device(
+                loader, self._place_eval_batch,
+                depth=self.prefetch_to_device,
+                recorder=self.telemetry_recorder)
+            try:
+                for batch_idx, (bs, device_batch) in enumerate(stream):
+                    if limit is not None and batch_idx >= limit:
+                        break
+                    metrics = self._eval_step(self.state.params,
+                                              device_batch)
+                    for k, v in metrics.items():
+                        # accumulate in f32 — a bf16 step metric summed
+                        # over hundreds of batches would round away the
+                        # increments
+                        scaled = jnp.asarray(v).astype(jnp.float32) * bs
+                        totals[k] = (totals[k] + scaled if k in totals
+                                     else scaled)
+                    weights += bs
+            finally:
+                if isinstance(stream, DevicePrefetcher):
+                    stream.close()
+            if (isinstance(self._eval_step, WarmStep)
+                    and self._eval_step.stats.total_s):
+                self.callback_metrics.update(
+                    self._eval_step.stats.to_metrics("val_"))
+            if sanity or weights == 0:
+                return {}
+            host = _to_host(totals)
+            return {k: float(v) / weights for k, v in host.items()}
 
     # ------------------------------------------------------- validate & co.
 
@@ -486,7 +563,11 @@ class Trainer:
         }
         self.module.on_save_checkpoint(checkpoint)
         self._invoke("on_save_checkpoint", checkpoint)
-        out = save_checkpoint(path, checkpoint, ckpt_meta, block=block)
+        # the span measures exactly what the TRAINING thread paid: the
+        # full write when blocking, the snapshot + any join-wait on a
+        # previous in-flight write when async
+        with self.telemetry_recorder.span(PH_CKPT, meta={"path": path}):
+            out = save_checkpoint(path, checkpoint, ckpt_meta, block=block)
         # checkpoint-overlap accounting: how long the TRAINING thread
         # stalled on checkpoint I/O (the async path's win is ~0 here)
         from ray_lightning_tpu.checkpoint.io import io_stats
@@ -709,7 +790,8 @@ class Trainer:
         # whole TrainState per step would put O(param leaves) host work
         # back on the hot path
         return WarmStep(jax.jit(step, donate_argnums=(0,)),
-                        label="train_step", check_args=(1,))
+                        label="train_step", check_args=(1,),
+                        recorder=self.telemetry_recorder)
 
     def _make_eval_step(self, module: TpuModule, step_fn):
         def step(params, batch):
@@ -725,7 +807,8 @@ class Trainer:
         # the AOT compile happens on the first eval batch (still recorded
         # as a first-class metric, val_compile_time_s)
         return WarmStep(jax.jit(step), label="eval_step",
-                        auto=self.warm_start, check_args=(1,))
+                        auto=self.warm_start, check_args=(1,),
+                        recorder=self.telemetry_recorder)
 
     def _warm_start_train_step(self, example_batch) -> None:
         """AOT lower().compile() the train step for the known shapes —
@@ -833,6 +916,79 @@ class Trainer:
             return contextlib.nullcontext()
         os.makedirs(self.profiler_dir, exist_ok=True)
         return _ProfilerCtx(self.profiler_dir)
+
+    # ------------------------------------------------------------ telemetry
+
+    def _setup_telemetry(self) -> None:
+        """Build the span recorder + profiler controller for this fit.
+        Host bookkeeping only — nothing here reaches the jitted step, so
+        telemetry=off vs on compile the byte-identical program."""
+        self.telemetry = TelemetryConfig.coerce(self.telemetry)
+        rank = jax.process_index()
+        if self.telemetry is not None:
+            self.telemetry_recorder = TelemetryRecorder(
+                directory=self.telemetry.resolved_dir(
+                    self.default_root_dir),
+                rank=rank, ring_size=self.telemetry.ring_size)
+            self._telemetry_flush_every = max(
+                1, self.telemetry.flush_every_n_steps)
+            self._launch_s = _launch_seconds()
+        self.profile = ProfileConfig.coerce(self.profile)
+        if self.profile is not None:
+            self._profiler = ProfilerController(self.profile, rank=rank)
+
+    def _write_telemetry_ledger(self, completed: bool) -> None:
+        """Refresh this rank's goodput ledger (telemetry/goodput.py) —
+        cadenced AND final, atomic replace, so a SIGKILLed attempt still
+        leaves an almost-current account for the driver to assemble."""
+        rec = self.telemetry_recorder
+        if not rec.enabled or rec.directory is None \
+                or self._fit_start_perf is None:
+            return
+        ledger = _goodput.worker_ledger(
+            rec, time.perf_counter() - self._fit_start_perf,
+            rank=rec.rank, start_step=self._fit_start_step,
+            end_step=self.global_step, launch_s=self._launch_s,
+            completed=completed)
+        _goodput.write_ledger(rec.directory, ledger, uid=rec.uid)
+
+    def _finalize_telemetry(self, completed: bool) -> None:
+        rec = self.telemetry_recorder
+        if not rec.enabled:
+            return
+        totals = rec.phase_totals()
+        wall = (time.perf_counter() - self._fit_start_perf
+                if self._fit_start_perf is not None else 0.0)
+        stalls = sum(totals.get(p, 0.0) for p in
+                     ("compile", "data_wait", "ckpt_stall", "eval",
+                      "metrics_fetch"))
+        self.callback_metrics.update({
+            "telemetry_compile_s": totals.get("compile", 0.0),
+            "telemetry_data_wait_s": totals.get("data_wait", 0.0),
+            "telemetry_ckpt_stall_s": totals.get("ckpt_stall", 0.0),
+            "telemetry_eval_s": totals.get("eval", 0.0),
+            "telemetry_spans_dropped": float(rec.dropped),
+            "goodput_fraction": (max(0.0, wall - stalls) / wall
+                                 if wall > 0 else 0.0),
+        })
+        self._write_telemetry_ledger(completed=completed)
+        rec.close()
+
+
+def _launch_seconds() -> float:
+    """Worker spawn -> fit start (imports, jax init, rendezvous) — the
+    goodput launch bucket. Zero outside a runtime worker: a local fit
+    has no spawn cost worth charging."""
+    try:
+        from ray_lightning_tpu.runtime import session
+
+        s = session.get_session()
+        started = getattr(s, "started_at", None) if s is not None else None
+        if started:
+            return max(0.0, time.time() - started)
+    except Exception:  # noqa: BLE001 — accounting must never fail a fit
+        pass
+    return 0.0
 
 
 class _ProfilerCtx:

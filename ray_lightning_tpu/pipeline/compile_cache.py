@@ -141,9 +141,18 @@ class WarmStep:
 
     def __init__(self, jitted: Callable, label: str = "step",
                  auto: bool = False,
-                 check_args: Optional[Tuple[int, ...]] = None):
+                 check_args: Optional[Tuple[int, ...]] = None,
+                 recorder: Any = None):
+        from ray_lightning_tpu.telemetry.spans import NULL_RECORDER
+
         self._jitted = jitted
         self._label = label
+        #: telemetry recorder (telemetry/spans.py): warm() runs under a
+        #: "compile" span, so heartbeats report the phase live (a
+        #: 20-minute big-model compile names itself instead of reading
+        #: as a frozen step counter) and the goodput compile bucket is
+        #: measured, not inferred
+        self._recorder = recorder or NULL_RECORDER
         self._compiled = None
         self._sig: Optional[Tuple] = None
         self._attempted = False
@@ -165,6 +174,13 @@ class WarmStep:
         """AOT-compile for ``example_args``' shapes. Failures degrade to
         the jitted path with a logged warning — warm start must never be
         able to fail a fit that plain jit would have survived."""
+        from ray_lightning_tpu.telemetry.spans import PH_COMPILE
+
+        with self._recorder.span(PH_COMPILE,
+                                 meta={"label": self._label}):
+            return self._warm_inner(*example_args)
+
+    def _warm_inner(self, *example_args: Any) -> CompileStats:
         self._attempted = True
         t0 = time.perf_counter()
         try:

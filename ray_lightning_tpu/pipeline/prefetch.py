@@ -43,6 +43,13 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable, Iterator, Optional
 
+from ray_lightning_tpu.telemetry.spans import (
+    NULL_RECORDER,
+    PH_DATA_WAIT,
+    PH_H2D,
+    THREAD_PRODUCER,
+)
+
 
 @dataclass
 class PrefetchStats:
@@ -92,10 +99,16 @@ class DevicePrefetcher(Iterable[Any]):
 
     def __init__(self, source: Iterable[Any],
                  place_fn: Callable[[Any], Any],
-                 depth: int = 2, name: str = "rlt-prefetch"):
+                 depth: int = 2, name: str = "rlt-prefetch",
+                 recorder: Any = None):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         self.depth = depth
+        #: telemetry span recorder (telemetry/spans.py): H2D placement
+        #: spans from the producer thread (overlapped with compute —
+        #: thread-tagged so goodput never double-charges them) and
+        #: data-wait spans when the consumer actually blocked
+        self._recorder = recorder or NULL_RECORDER
         self.stats = PrefetchStats(_depth=depth)
         self._source = iter(source)
         self._place = place_fn
@@ -113,7 +126,9 @@ class DevicePrefetcher(Iterable[Any]):
             for item in self._source:
                 if self._stop.is_set():
                     return
-                placed = self._place(item)
+                with self._recorder.span(PH_H2D,
+                                         thread=THREAD_PRODUCER):
+                    placed = self._place(item)
                 # bounded put with a timeout poll so close() can always
                 # unblock the producer even if the consumer vanished
                 # without draining
@@ -160,6 +175,10 @@ class DevicePrefetcher(Iterable[Any]):
             self.stats.hits += 1
         else:
             self.stats.wait_s += waited
+            # a miss is real main-thread data-wait: the device's input
+            # was not resident when the loop asked — the timeline span
+            # that explains a goodput data_wait bucket
+            self._recorder.record(PH_DATA_WAIT, t0, waited)
         return item
 
     # ---- lifecycle -------------------------------------------------------
@@ -200,9 +219,21 @@ class DevicePrefetcher(Iterable[Any]):
 
 def prefetch_to_device(source: Iterable[Any],
                        place_fn: Callable[[Any], Any],
-                       depth: int = 2) -> Iterable[Any]:
+                       depth: int = 2,
+                       recorder: Any = None) -> Iterable[Any]:
     """Functional form: ``depth <= 0`` returns the synchronous pipeline
-    (place inline, no thread) so call sites can switch with one knob."""
+    (place inline, no thread) so call sites can switch with one knob.
+    ``recorder`` (telemetry/spans.py) tags H2D/data-wait spans; in the
+    synchronous path the placement blocks the main thread, so its span
+    is main-thread (timeline-visible, deliberately outside the goodput
+    stall buckets — it is the cost the prefetcher exists to hide)."""
     if depth <= 0:
-        return (place_fn(item) for item in source)
-    return DevicePrefetcher(source, place_fn, depth=depth)
+        rec = recorder or NULL_RECORDER
+        def _sync():
+            for item in source:
+                with rec.span(PH_H2D):
+                    placed = place_fn(item)
+                yield placed
+        return _sync()
+    return DevicePrefetcher(source, place_fn, depth=depth,
+                            recorder=recorder)

@@ -38,9 +38,18 @@ log = get_logger(__name__)
 HEARTBEAT_KIND = "rlt.heartbeat"
 
 
-def make_heartbeat(rank: int, step: int, phase: str = "step") -> Dict[str, Any]:
-    return {"kind": HEARTBEAT_KIND, "rank": rank, "step": int(step),
-            "phase": phase, "sent_at": time.time()}
+def make_heartbeat(rank: int, step: int, phase: str = "step",
+                   span: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """``phase`` is the worker's CURRENT telemetry span phase (what the
+    main thread is inside right now); ``span`` is the last completed
+    span's summary — together a silent-channel stall report can say
+    "hung in ckpt_stall at step 812" instead of just "hung"."""
+    hb = {"kind": HEARTBEAT_KIND, "rank": rank, "step": int(step),
+          "phase": phase, "sent_at": time.time()}
+    if span:
+        hb["span"] = {"phase": span.get("phase"),
+                      "dur": span.get("dur"), "step": span.get("step")}
+    return hb
 
 
 def is_heartbeat(item: Any) -> bool:
@@ -69,13 +78,23 @@ class HeartbeatCallback(Callback):
         stop = self._stop
 
         def _beat():
-            phase = "setup"
             while not stop.wait(self.interval_s):
                 try:
                     step = int(self._trainer.global_step)
-                    if step > 0:
-                        phase = "step"
-                    session.put_queue(make_heartbeat(rank, step, phase))
+                    # the telemetry recorder's live phase is the
+                    # authoritative answer to "what is this worker
+                    # doing"; without one, fall back to the step-counter
+                    # heuristic this module used before telemetry existed
+                    rec = getattr(self._trainer, "telemetry_recorder",
+                                  None)
+                    phase = rec.current_phase() if rec is not None \
+                        and rec.enabled else ""
+                    span = rec.last_span() if rec is not None \
+                        and rec.enabled else None
+                    if not phase:
+                        phase = "step" if step > 0 else "setup"
+                    session.put_queue(
+                        make_heartbeat(rank, step, phase, span=span))
                 except Exception:  # noqa: BLE001 — channel closing during
                     # teardown, or a send racing shutdown; never crash the
                     # worker over telemetry
@@ -123,6 +142,7 @@ class HealthMonitor:
             self._last_seen: Dict[int, float] = {}
             self._last_step: Dict[int, int] = {}
             self._step_since: Dict[int, float] = {}
+            self._last_phase: Dict[int, str] = {}
             self._noted_stall: set = set()
 
     def consume(self, rank: int, item: Any) -> bool:
@@ -134,6 +154,7 @@ class HealthMonitor:
             hb_rank = int(item.get("rank", rank))
             step = int(item.get("step", -1))
             self._last_seen[hb_rank] = now
+            self._last_phase[hb_rank] = str(item.get("phase", ""))
             if self._last_step.get(hb_rank) != step:
                 self._last_step[hb_rank] = step
                 self._step_since[hb_rank] = now
@@ -157,24 +178,42 @@ class HealthMonitor:
                     continue
                 silent = now - seen
                 if silent > self.stall_timeout_s:
-                    raise StallError(rank, silent)
+                    raise StallError(
+                        rank, silent,
+                        phase=self._last_phase.get(rank, ""),
+                        step=self._last_step.get(rank, -1))
                 frozen = now - self._step_since.get(rank, now)
                 if (frozen > self.step_stall_note_s
                         and rank not in self._noted_stall):
                     self._noted_stall.add(rank)
-                    log.warning(
-                        "rank %d: heartbeats live but step %d unchanged "
-                        "for %.0fs — compiling or a slow step (not "
-                        "killing; the silent-channel budget is %.0fs)",
-                        rank, self._last_step.get(rank, -1), frozen,
-                        self.stall_timeout_s)
+                    phase = self._last_phase.get(rank, "")
+                    if phase == "compile":
+                        # not an inference from a frozen counter: the
+                        # worker's live compile span says so
+                        log.warning(
+                            "rank %d: inside an XLA compile for %.0fs "
+                            "(telemetry span; heartbeats live, step %d) "
+                            "— not killing; big-model compiles "
+                            "legitimately take tens of minutes",
+                            rank, frozen, self._last_step.get(rank, -1))
+                    else:
+                        log.warning(
+                            "rank %d: heartbeats live but step %d "
+                            "unchanged for %.0fs%s — a slow step or a "
+                            "wedged phase (not killing; the "
+                            "silent-channel budget is %.0fs)",
+                            rank, self._last_step.get(rank, -1), frozen,
+                            f" (phase {phase!r})" if phase else "",
+                            self.stall_timeout_s)
 
-    def snapshot(self) -> Dict[int, Dict[str, float]]:
-        """Telemetry view (tests + CLI): per-rank last-seen age / step."""
+    def snapshot(self) -> Dict[int, Dict[str, Any]]:
+        """Telemetry view (tests + CLI): per-rank last-seen age / step /
+        reported phase."""
         now = time.monotonic()
         with self._lock:
             return {
                 r: {"silent_s": now - self._last_seen[r],
-                    "step": self._last_step.get(r, -1)}
+                    "step": self._last_step.get(r, -1),
+                    "phase": self._last_phase.get(r, "")}
                 for r in self._last_seen
             }

@@ -47,13 +47,24 @@ class FailureKind:
 
 class StallError(RuntimeError):
     """A worker's heartbeat channel went silent past the stall budget
-    (health.HealthMonitor) — the process is hung, not compiling."""
+    (health.HealthMonitor) — the process is hung, not compiling.
 
-    def __init__(self, rank: int, silent_s: float, detail: str = ""):
+    ``phase``/``step`` come from the last heartbeat's telemetry payload
+    (the worker's current span phase): the report upgrades from "hung"
+    to "hung in <phase> at step N" — the difference between rebooting a
+    pod and knowing to look at the checkpoint filesystem."""
+
+    def __init__(self, rank: int, silent_s: float, detail: str = "",
+                 phase: str = "", step: int = -1):
         self.rank = rank
         self.silent_s = silent_s
+        self.phase = phase
+        self.step = step
         msg = (f"worker rank {rank} sent no heartbeat for "
                f"{silent_s:.0f}s (channel silent — hung, not compiling)")
+        if phase:
+            msg += (f"; last reported doing {phase!r}"
+                    + (f" at step {step}" if step >= 0 else ""))
         if detail:
             msg += f": {detail}"
         super().__init__(msg)

@@ -25,6 +25,7 @@ attached (runtime/group.py) — stays chained underneath.
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import os
 import signal as _signal
@@ -83,12 +84,27 @@ class ResilienceConfig:
     #: the data order past the poisoned window, and record any
     #: quarantined rank in <checkpoint_dir>/.quarantine.json.
     guard: Any = None
+    #: telemetry (telemetry/, docs/OBSERVABILITY.md): True (default)
+    #: arms the span recorder in every worker with the run's shared
+    #: ``<checkpoint_dir>/telemetry`` dir, the driver records its own
+    #: attempt/backoff spans there, and supervise() assembles the
+    #: goodput classification into SupervisedResult.goodput +
+    #: ``telemetry/goodput.json``. False disables end to end.
+    telemetry: Any = True
 
     def resolved_compile_cache_dir(self) -> Optional[str]:
         if self.compile_cache_dir == "off":
             return None
         return self.compile_cache_dir or os.path.join(
             self.checkpoint_dir, ".compile_cache")
+
+    def resolved_telemetry_dir(self) -> Optional[str]:
+        if not self.telemetry:
+            return None
+        from ray_lightning_tpu.telemetry import TelemetryConfig
+
+        cfg = TelemetryConfig.coerce(self.telemetry)
+        return cfg.dir or os.path.join(self.checkpoint_dir, "telemetry")
 
 
 @dataclasses.dataclass
@@ -102,6 +118,10 @@ class SupervisedResult:
     rollbacks: int = 0              # trainguard corruption rollbacks
     quarantined: List[int] = dataclasses.field(default_factory=list)
     #                                 ranks the SDC probe attributed
+    #: goodput classification of the TOTAL supervised wall time
+    #: (telemetry/goodput.py buckets; None when telemetry is off) —
+    #: also written to <checkpoint_dir>/telemetry/goodput.json
+    goodput: Optional[Dict[str, Any]] = None
 
     @property
     def total_attempts(self) -> int:
@@ -201,6 +221,14 @@ def _wrapped_trainer_factory(trainer_factory: Callable[[], Any],
             # the poisoned window (trainer._apply_rollback_skip; stale
             # markers from older incidents no-op there)
             trainer.resume_skip_past = marker
+    tdir = cfg.resolved_telemetry_dir()
+    if tdir and trainer.telemetry is None:
+        # every supervised worker records spans + goodput ledgers into
+        # the run's shared telemetry dir; an explicit Trainer(telemetry=)
+        # wins — the user already chose a destination
+        from ray_lightning_tpu.telemetry import TelemetryConfig
+
+        trainer.telemetry = TelemetryConfig(dir=tdir)
     faults = parse_faults(cfg.faults) if cfg.faults else faults_from_env()
     if faults:
         state_dir = (cfg.fault_state_dir
@@ -269,6 +297,42 @@ def supervise(
 
     wrapped_tf = partial(_wrapped_trainer_factory, trainer_factory, cfg)
 
+    # driver-side telemetry: attempt/backoff spans into the run's shared
+    # dir (rank -1 = the driver), plus the wall/backoff ledger the
+    # goodput assembly closes its books against
+    telemetry_dir = (cfg.resolved_telemetry_dir() if kind == "fit"
+                     else None)
+    driver_rec = None
+    if telemetry_dir:
+        from ray_lightning_tpu.telemetry.spans import (
+            PH_ATTEMPT,
+            PH_BACKOFF,
+            TelemetryRecorder,
+        )
+
+        driver_rec = TelemetryRecorder(directory=telemetry_dir, rank=-1)
+    wall_t0 = time.perf_counter()
+    backoff_s = 0.0
+
+    def _assemble(restarts, preemptions, rollbacks):
+        if telemetry_dir is None:
+            return None
+        from ray_lightning_tpu.telemetry import goodput as _gp
+
+        try:
+            if driver_rec is not None:
+                driver_rec.close()
+            report = _gp.assemble_goodput(
+                telemetry_dir, time.perf_counter() - wall_t0,
+                backoff_s=backoff_s, restarts=restarts,
+                preemptions=preemptions, rollbacks=rollbacks)
+            _gp.write_goodput(telemetry_dir, report)
+            return report
+        except Exception:  # noqa: BLE001 — accounting must never cost
+            # the run its result
+            log.exception("goodput assembly failed")
+            return None
+
     restarts = 0
     preemptions = 0
     rollbacks = 0
@@ -279,18 +343,25 @@ def supervise(
             monitor.reset()
         attempts = 1 + restarts + preemptions + rollbacks
         try:
-            result = run_distributed(
-                kind, module_factory, wrapped_tf, data_factory,
-                num_processes,
-                ckpt_path=ckpt_path,
-                on_queue_item=_on_queue_item,
-                watchdog=(_watchdog if (monitor is not None
-                                        or user_watchdog is not None)
-                          else None),
-                **kw,
-            )
+            attempt_ctx = (driver_rec.span(PH_ATTEMPT,
+                                           meta={"attempt": attempts})
+                           if driver_rec is not None
+                           else contextlib.nullcontext())
+            with attempt_ctx:
+                result = run_distributed(
+                    kind, module_factory, wrapped_tf, data_factory,
+                    num_processes,
+                    ckpt_path=ckpt_path,
+                    on_queue_item=_on_queue_item,
+                    watchdog=(_watchdog if (monitor is not None
+                                            or user_watchdog is not None)
+                              else None),
+                    **kw,
+                )
             return SupervisedResult(result, restarts, preemptions,
-                                    failures, rollbacks, quarantined)
+                                    failures, rollbacks, quarantined,
+                                    goodput=_assemble(
+                                        restarts, preemptions, rollbacks))
         except BaseException as exc:
             if isinstance(exc, (KeyboardInterrupt, SystemExit)):
                 raise
@@ -300,8 +371,12 @@ def supervise(
             log.warning("supervised attempt %d failed: [%s/%s] %s",
                         attempts, fc.kind, fc.cause, fc.detail)
             if fc.kind == FailureKind.FATAL:
+                # land the driver's attempt/backoff spans for the
+                # post-mortem report before failing for good
+                _assemble(restarts, preemptions, rollbacks)
                 raise SupervisedFailure(fc, attempts) from exc
             if not policy.allows(restarts, preemptions, fc, rollbacks):
+                _assemble(restarts, preemptions, rollbacks)
                 raise RestartBudgetExceeded(
                     fc, attempts,
                     policy.max_rollbacks
@@ -325,7 +400,12 @@ def supervise(
                 "rollbacks %d) in %.1fs, resuming from %s",
                 restarts + preemptions + rollbacks, restarts,
                 preemptions, rollbacks, delay, ckpt_path or "scratch")
-            time.sleep(delay)
+            backoff_ctx = (driver_rec.span(PH_BACKOFF)
+                           if driver_rec is not None
+                           else contextlib.nullcontext())
+            with backoff_ctx:
+                time.sleep(delay)
+            backoff_s += delay
 
 
 def _rollback_target(cfg: ResilienceConfig, rollbacks: int,

@@ -17,10 +17,15 @@ from typing import Any, Optional
 
 
 class TpuSession:
-    def __init__(self, rank: int, world_size: int, queue: Optional[Any]):
+    def __init__(self, rank: int, world_size: int, queue: Optional[Any],
+                 started_at: Optional[float] = None):
         self.rank = rank
         self.world_size = world_size
         self.queue = queue
+        #: wall-clock of worker-process start (worker.py stamps its own
+        #: import time) — telemetry's goodput launch bucket measures
+        #: spawn -> fit start against this
+        self.started_at = started_at
 
     def put_queue(self, item: Any) -> None:
         if self.queue is None:
@@ -35,6 +40,7 @@ _session: Optional[TpuSession] = None
 
 
 def init_session(rank: int, world_size: int = 1, queue: Optional[Any] = None,
+                 started_at: Optional[float] = None,
                  _overwrite: bool = True) -> None:
     """Bind the process-global session. Unlike the reference (which raises
     on double init, session.py:30-36) re-binding is allowed so a worker
@@ -43,7 +49,7 @@ def init_session(rank: int, world_size: int = 1, queue: Optional[Any] = None,
     global _session
     if _session is not None and not _overwrite:
         raise ValueError("a session already exists in this process")
-    _session = TpuSession(rank, world_size, queue)
+    _session = TpuSession(rank, world_size, queue, started_at=started_at)
 
 
 def get_session() -> Optional[TpuSession]:

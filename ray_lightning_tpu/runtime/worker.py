@@ -49,10 +49,16 @@ import os
 import socket
 import sys
 import threading
+import time
 import traceback
 from multiprocessing.connection import Client
 
 import cloudpickle
+
+#: stamped at import — the earliest observable moment of this worker's
+#: life; telemetry's goodput "launch" bucket (spawn -> fit start)
+#: measures against it via the session registry
+_PROC_START = time.time()
 
 
 def _node_ip() -> str:
@@ -104,7 +110,8 @@ def _bind_session(channel: _WorkerChannel) -> None:
     from ray_lightning_tpu.runtime import session
 
     session.init_session(
-        rank=channel.rank, world_size=channel.world, queue=channel
+        rank=channel.rank, world_size=channel.world, queue=channel,
+        started_at=_PROC_START,
     )
 
 

@@ -1,0 +1,75 @@
+"""Telemetry: span timeline, goodput accounting, on-demand profiling,
+and the static-vs-measured reconciliation report (docs/OBSERVABILITY.md).
+
+  spans.py     host-side span recorder (bounded ring -> per-rank JSONL);
+               zero new host syncs, byte-identical program when off
+  goodput.py   productive/compile/data-wait/ckpt-stall/backoff/replay
+               wall-time classification, worker ledgers + driver assembly
+  profiler.py  Trainer(profile=ProfileConfig(...)): step-window /
+               marker-file / SIGUSR1 jax.profiler capture, rank-scoped
+  report.py    `python -m ray_lightning_tpu report|monitor` — timeline,
+               goodput, and the drift join against tracecheck
+"""
+from ray_lightning_tpu.telemetry.goodput import (  # noqa: F401
+    GOODPUT_BUCKETS,
+    GOODPUT_SCHEMA,
+    assemble_goodput,
+    buckets_consistent,
+    read_goodput,
+    worker_ledger,
+    write_goodput,
+    write_ledger,
+)
+from ray_lightning_tpu.telemetry.profiler import (  # noqa: F401
+    ProfileConfig,
+    ProfilerController,
+)
+from ray_lightning_tpu.telemetry.spans import (  # noqa: F401
+    NULL_RECORDER,
+    PHASES,
+    NullRecorder,
+    TelemetryRecorder,
+    read_spans,
+)
+
+__all__ = [
+    "GOODPUT_BUCKETS", "GOODPUT_SCHEMA", "assemble_goodput",
+    "buckets_consistent", "read_goodput", "worker_ledger",
+    "write_goodput", "write_ledger", "ProfileConfig",
+    "ProfilerController", "NULL_RECORDER", "PHASES", "NullRecorder",
+    "TelemetryRecorder", "TelemetryConfig", "read_spans",
+]
+
+
+import dataclasses as _dc
+import os as _os
+from typing import Any as _Any, Optional as _Optional
+
+
+@_dc.dataclass
+class TelemetryConfig:
+    """``Trainer(telemetry=...)`` — True for defaults, a directory
+    string, or this. ``dir=None`` derives ``<root_dir>/telemetry``."""
+
+    dir: _Optional[str] = None
+    ring_size: int = 4096
+    #: span-file + ledger flush cadence in steps (rides the trainer's
+    #: logging cadence when larger)
+    flush_every_n_steps: int = 50
+
+    @classmethod
+    def coerce(cls, value: _Any) -> _Optional["TelemetryConfig"]:
+        if value is None or value is False:
+            return None
+        if value is True:
+            return cls()
+        if isinstance(value, cls):
+            return value
+        if isinstance(value, str):
+            return cls(dir=value)
+        raise TypeError(
+            f"telemetry= takes True, a directory string, or a "
+            f"TelemetryConfig; got {type(value).__name__}")
+
+    def resolved_dir(self, root_dir: str) -> str:
+        return self.dir or _os.path.join(root_dir, "telemetry")

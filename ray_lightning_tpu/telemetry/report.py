@@ -1,0 +1,579 @@
+"""``python -m ray_lightning_tpu report|monitor`` — the measured side of
+the analysis stack, and the first closed loop against it.
+
+``report <run_dir>`` reads the per-rank span JSONL + goodput ledgers a
+telemetry-enabled run left under ``<run_dir>/telemetry`` and prints:
+
+  * the goodput classification (telemetry/goodput.py buckets, summing
+    to supervised wall time),
+  * per-rank phase totals and warm-window step-time stats,
+  * with ``--preset/--topo``: a DRIFT section joining the measured
+    timeline against tracecheck's per-topology prediction for that step
+    (modeled compute window + exposed ICI vs measured step time, static
+    ``overlap_hidden_fraction`` restated next to the measured numbers).
+    When the run dir holds no measured spans — backend down, telemetry
+    off — the drift section still emits, with a structured-skip
+    placeholder in the measured slot, so consumers never see a shape
+    change (the bench.py skip-line contract, applied to reports).
+
+``monitor <run_dir>`` is the live view: last span + current phase per
+rank and the partial goodput, one shot (or ``--follow``).
+
+``monitor --smoke`` is the format.sh gate (docs/OBSERVABILITY.md):
+  1. telemetry=off pin — two tiny fits, recorder off vs on, must train
+     BITWISE-identically and lower byte-identical step programs;
+  2. a 2-proc CPU-SPMD supervised run with an injected worker kill must
+     produce a parseable goodput report whose buckets sum to supervised
+     wall time (±5%) and whose backoff + replay classes are nonzero;
+  3. the flagship llama3-8b drift section must emit (structured-skip
+     measured placeholder on a box with no TPU) against tracecheck's
+     predicted step composition.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_lightning_tpu.telemetry import goodput as gp
+from ray_lightning_tpu.telemetry.spans import PH_STEP, read_spans
+
+#: |measured/predicted - 1| beyond this flags drift (the cost model is
+#: a roofline with MXU_EFFICIENCY derating — docs/STATIC_ANALYSIS.md)
+DRIFT_THRESHOLD = 0.25
+
+
+# ---------------------------------------------------------------- timeline
+
+
+def telemetry_dir(run_dir: str) -> str:
+    """Accept either the run dir or the telemetry dir itself."""
+    if glob.glob(os.path.join(run_dir, "rank*.spans.jsonl")):
+        return run_dir
+    return os.path.join(run_dir, "telemetry")
+
+
+def load_timeline(run_dir: str) -> Dict[str, Any]:
+    """Assemble the clock-aligned cross-rank view from the span files.
+    Restarted attempts leave one pid-tagged file each per rank — they
+    are merged in wall-clock order (totals accumulate; the "current"
+    phase comes from the newest attempt)."""
+    tdir = telemetry_dir(run_dir)
+    ranks: Dict[int, Dict[str, Any]] = {}
+    paths = sorted(glob.glob(os.path.join(tdir, "rank*.spans.jsonl")))
+    parsed_files = []
+    for path in paths:
+        parsed = read_spans(path)
+        rank = int(parsed["header"].get("rank", -1)) \
+            if parsed["header"] else -1
+        t0 = (parsed["header"] or {}).get("t0_wall") or 0.0
+        parsed_files.append((rank, t0, path, parsed))
+    parsed_files.sort(key=lambda e: (e[0], e[1]))
+    for rank, t0, path, parsed in parsed_files:
+        info = ranks.setdefault(rank, {
+            "paths": [], "t0_wall": None, "phase_totals": {},
+            "phase_counts": {}, "step_durs": [], "last_span": None,
+            "dropped": 0, "attempts": 0,
+        })
+        info["paths"].append(path)
+        info["attempts"] += 1
+        info["t0_wall"] = t0  # newest attempt wins (sorted ascending)
+        for span in parsed["spans"]:
+            phase = span.get("phase", "?")
+            if span.get("thread", "main") == "main":
+                # "excl" is the nested-exclusive charge the recorder
+                # persisted — summing raw durs would double-count a
+                # compile inside an eval span
+                info["phase_totals"][phase] = (
+                    info["phase_totals"].get(phase, 0.0)
+                    + float(span.get("excl", span.get("dur", 0.0))))
+                info["phase_counts"][phase] = (
+                    info["phase_counts"].get(phase, 0) + 1)
+            if phase == PH_STEP:
+                info["step_durs"].append(float(span.get("dur", 0.0)))
+            info["last_span"] = span
+        info["dropped"] += parsed["dropped"]
+    return {"telemetry_dir": tdir, "ranks": ranks,
+            "step_stats": _step_stats(ranks)}
+
+
+def _step_stats(ranks: Dict[int, Dict[str, Any]]) -> Optional[dict]:
+    """Warm-window step-time stats over rank 0's per-step spans; the
+    first interval (cold step: lazy compile, cache population) is
+    dropped — same convention as ThroughputMonitor."""
+    r0 = ranks.get(0) or (next(iter(ranks.values())) if ranks else None)
+    if not r0:
+        return None
+    durs = r0["step_durs"][1:] if len(r0["step_durs"]) > 1 \
+        else r0["step_durs"]
+    if not durs:
+        return None
+    durs = sorted(durs)
+    return {
+        "steps": len(durs),
+        "mean_s": sum(durs) / len(durs),
+        "p50_s": durs[len(durs) // 2],
+        "max_s": durs[-1],
+    }
+
+
+# ------------------------------------------------------------------ drift
+
+
+def predicted_step_composition(preset: str, topo_str: str,
+                               overlap: str = "off") -> Dict[str, Any]:
+    """tracecheck's prediction for one (preset, topology) pair: the
+    modeled per-step compute window, exposed/hidden ICI time, and the
+    static overlap fraction — the numbers a measured run is reconciled
+    against. Degrades to {"error": ...} rather than raising (the drift
+    section is advisory; an analysis bug must not fail the report)."""
+    try:
+        from ray_lightning_tpu.analysis.cli import resolve_trace_target
+        from ray_lightning_tpu.analysis.costmodel import parse_topology
+        from ray_lightning_tpu.analysis.tracecheck import audit_step
+
+        topo = parse_topology(topo_str)
+        built = resolve_trace_target(preset, topo, overlap=overlap)
+        if built is None:
+            return {"error": f"unknown preset {preset!r}"}
+        module, strategy, batch, label = built
+        report = audit_step(module, strategy, batch, topology=topo,
+                            label=label)
+        ov = report.overlap or {}
+        compute_us = 0.0
+        for sc in ov.get("per_scope", ()):
+            compute_us += float(sc.get("compute_us_per_trip", 0.0)) \
+                * float(sc.get("trips", 1))
+        predicted: Dict[str, Any] = {
+            "label": label,
+            "topology": topo.name,
+            "ici_time_us": round(report.ici_time_us, 1),
+            "ici_exposed_us": round(report.ici_exposed_us, 1),
+            "ici_hidden_us": round(report.ici_hidden_us, 1),
+            "overlap_hidden_fraction": round(
+                report.overlap_hidden_fraction, 4),
+            "compute_us": round(compute_us, 1) if compute_us else None,
+            "assumptions": (
+                "roofline compute window (costmodel.compute_time_us, "
+                "MXU-derated spec peak) over traced scan scopes + exposed "
+                "ICI serialized with compute; host time not modeled"),
+        }
+        if compute_us:
+            predicted["step_us"] = round(
+                compute_us + report.ici_exposed_us, 1)
+        else:
+            predicted["step_us"] = None
+        return predicted
+    except Exception as exc:  # noqa: BLE001 — advisory section
+        return {"error": f"{type(exc).__name__}: {str(exc)[:300]}"}
+
+
+def build_drift(predicted: Dict[str, Any],
+                timeline: Optional[Dict[str, Any]],
+                threshold: float = DRIFT_THRESHOLD) -> Dict[str, Any]:
+    """Join measured vs predicted; flags name what disagrees. With no
+    measured spans the measured slot is the structured-skip placeholder
+    — same keys, null values, a "skipped" reason — never a missing
+    section."""
+    drift: Dict[str, Any] = {"predicted": predicted, "threshold": threshold}
+    stats = (timeline or {}).get("step_stats")
+    if not stats:
+        drift["measured"] = {
+            "step_us": None, "steps": 0,
+            "skipped": "no measured telemetry spans (backend down, "
+                       "telemetry off, or the run never stepped)",
+        }
+        drift["flags"] = []
+        drift["verdict"] = "not-measured"
+        return drift
+    # p50, not mean: a step span that crosses an epoch boundary carries
+    # the eval epoch + checkpoint inside its interval and would skew a
+    # mean by orders of magnitude; the median is the honest per-step
+    # wall (the boundary outliers are already itemized as eval/ckpt
+    # spans in their own right)
+    measured_us = stats["p50_s"] * 1e6
+    drift["measured"] = {"step_us": round(measured_us, 1),
+                         "steps": stats["steps"],
+                         "mean_us": round(stats["mean_s"] * 1e6, 1)}
+    flags: List[str] = []
+    pred_us = predicted.get("step_us")
+    if pred_us:
+        ratio = measured_us / pred_us
+        drift["step_time_ratio"] = round(ratio, 3)
+        if abs(ratio - 1.0) > threshold:
+            direction = "slower" if ratio > 1 else "faster"
+            flags.append(
+                f"measured step {measured_us / 1e3:.2f} ms is "
+                f"{ratio:.2f}x the modeled compute+exposed-ICI floor "
+                f"({pred_us / 1e3:.2f} ms) — {direction} than the cost "
+                "model beyond the threshold; the static "
+                "overlap_hidden_fraction "
+                f"({predicted.get('overlap_hidden_fraction')}) may not "
+                "be realized on this hardware")
+    elif predicted.get("error"):
+        flags.append(f"prediction unavailable: {predicted['error']}")
+    else:
+        flags.append("cost model produced no compute window for this "
+                     "step (no scanned scopes); only ICI time was "
+                     "predicted — step-time drift not judged")
+    drift["flags"] = flags
+    drift["verdict"] = "drift" if (pred_us and flags) else "ok" \
+        if pred_us else "partial-model"
+    return drift
+
+
+# ------------------------------------------------------------------ report
+
+
+def add_report_parser(sub) -> None:
+    p = sub.add_parser(
+        "report",
+        help="goodput + span-timeline report for a telemetry-enabled "
+             "run dir; --preset/--topo adds the static-vs-measured "
+             "drift section (docs/OBSERVABILITY.md)")
+    p.add_argument("run_dir",
+                   help="run dir (or its telemetry/ subdir) holding "
+                        "rank*.spans.jsonl / goodput ledgers")
+    p.add_argument("--preset", default=None,
+                   help="tracecheck target for the drift section (e.g. "
+                        "llama3-8b, or a bundled example name)")
+    p.add_argument("--topo", default="v5p-64",
+                   help="topology the prediction is priced for")
+    p.add_argument("--overlap", choices=("off", "on", "serial"),
+                   default="off")
+    p.add_argument("--drift-threshold", type=float,
+                   default=DRIFT_THRESHOLD)
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   default=argparse.SUPPRESS)
+
+
+def build_report(run_dir: str, preset: Optional[str] = None,
+                 topo: str = "v5p-64", overlap: str = "off",
+                 threshold: float = DRIFT_THRESHOLD) -> Dict[str, Any]:
+    timeline = load_timeline(run_dir)
+    out: Dict[str, Any] = {
+        "run_dir": run_dir,
+        "telemetry_dir": timeline["telemetry_dir"],
+        "ranks": sorted(timeline["ranks"]),
+        "step_stats": timeline["step_stats"],
+        "phase_totals": {
+            str(r): v["phase_totals"]
+            for r, v in sorted(timeline["ranks"].items())},
+        "goodput": gp.read_goodput(timeline["telemetry_dir"]),
+    }
+    if preset:
+        predicted = predicted_step_composition(preset, topo, overlap)
+        out["drift"] = build_drift(predicted, timeline, threshold)
+    return out
+
+
+def _print_report(out: Dict[str, Any]) -> None:
+    print(f"telemetry report: {out['run_dir']}")
+    g = out.get("goodput")
+    if g:
+        print(f"goodput: {g['goodput_fraction']:.1%} of "
+              f"{g['wall_s']:.1f}s wall productive "
+              f"({g['events']['restarts']} restart(s), "
+              f"{g['events']['preemptions']} preemption(s), "
+              f"{g['events']['rollbacks']} rollback(s))")
+        for b, v in g["buckets"].items():
+            if v:
+                print(f"  {b:<20} {v:8.2f}s  "
+                      f"{v / g['wall_s']:6.1%}")
+    else:
+        print("goodput: no assembled goodput.json (run was not "
+              "supervised, or is still in flight)")
+    ss = out.get("step_stats")
+    if ss:
+        print(f"warm step time: mean {ss['mean_s'] * 1e3:.2f} ms / "
+              f"p50 {ss['p50_s'] * 1e3:.2f} ms over {ss['steps']} steps")
+    for rank, totals in (out.get("phase_totals") or {}).items():
+        hot = ", ".join(f"{k}={v:.2f}s" for k, v in sorted(
+            totals.items(), key=lambda kv: -kv[1])[:5])
+        print(f"  rank {rank}: {hot or 'no spans'}")
+    drift = out.get("drift")
+    if drift:
+        pred = drift["predicted"]
+        print(f"drift vs tracecheck ({pred.get('label', '?')} on "
+              f"{pred.get('topology', '?')}):")
+        meas = drift["measured"]
+        if meas.get("skipped"):
+            print(f"  measured: SKIPPED — {meas['skipped']}")
+        else:
+            print(f"  measured step {meas['step_us'] / 1e3:.2f} ms over "
+                  f"{meas['steps']} warm steps")
+        if pred.get("step_us"):
+            print(f"  predicted step floor "
+                  f"{pred['step_us'] / 1e3:.2f} ms (compute "
+                  f"{(pred.get('compute_us') or 0) / 1e3:.2f} ms + "
+                  f"exposed ICI {pred['ici_exposed_us'] / 1e3:.2f} ms; "
+                  f"static overlap_hidden_fraction "
+                  f"{pred['overlap_hidden_fraction']})")
+        for flag in drift["flags"]:
+            print(f"  DRIFT: {flag}")
+        print(f"  verdict: {drift['verdict']}")
+
+
+def run_report(args) -> int:
+    if not os.path.isdir(args.run_dir):
+        print(f"error: {args.run_dir} is not a directory",
+              file=sys.stderr)
+        return 2
+    out = build_report(args.run_dir, preset=args.preset, topo=args.topo,
+                       overlap=args.overlap,
+                       threshold=args.drift_threshold)
+    if getattr(args, "as_json", False):
+        print(json.dumps(out))
+    else:
+        _print_report(out)
+    return 0
+
+
+# ----------------------------------------------------------------- monitor
+
+
+def add_monitor_parser(sub) -> None:
+    p = sub.add_parser(
+        "monitor",
+        help="live per-rank phase view of a telemetry-enabled run; "
+             "--smoke is the format.sh observability gate")
+    p.add_argument("run_dir", nargs="?", default=None)
+    p.add_argument("--follow", action="store_true",
+                   help="refresh every --interval seconds until ^C")
+    p.add_argument("--interval", type=float, default=5.0)
+    p.add_argument("--smoke", action="store_true",
+                   help="gate mode: telemetry=off byte-identical pin, "
+                        "2-proc fault-injected goodput report (buckets "
+                        "sum to wall, lost classes nonzero), flagship "
+                        "drift section emits")
+    p.add_argument("--flagship-topo", default="v5p-64",
+                   help="topology for the smoke's flagship drift leg")
+    p.add_argument("--processes", type=int, default=2)
+    p.add_argument("--timeout", type=float, default=600.0,
+                   help="per-attempt wall budget for the smoke's "
+                        "supervised leg")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   default=argparse.SUPPRESS)
+
+
+def _monitor_once(run_dir: str) -> Dict[str, Any]:
+    timeline = load_timeline(run_dir)
+    now = time.time()
+    view: Dict[str, Any] = {"run_dir": run_dir, "ranks": {}}
+    for rank, info in sorted(timeline["ranks"].items()):
+        last = info.get("last_span") or {}
+        age = None
+        if info.get("t0_wall") is not None and last:
+            age = now - (info["t0_wall"] + last.get("t", 0.0)
+                         + last.get("dur", 0.0))
+        view["ranks"][str(rank)] = {
+            "phase": last.get("phase"),
+            "step": last.get("step"),
+            "last_span_age_s": round(age, 1) if age is not None else None,
+            "dropped": info["dropped"],
+        }
+    view["goodput"] = gp.read_goodput(timeline["telemetry_dir"])
+    view["step_stats"] = timeline["step_stats"]
+    return view
+
+
+def run_monitor(args) -> int:
+    if args.smoke:
+        return _run_smoke(args)
+    if not args.run_dir:
+        print("error: pass a run dir or --smoke", file=sys.stderr)
+        return 2
+    as_json = getattr(args, "as_json", False)
+    while True:
+        view = _monitor_once(args.run_dir)
+        if as_json:
+            print(json.dumps(view), flush=True)
+        else:
+            ss = view.get("step_stats")
+            extra = (f"  warm step {ss['mean_s'] * 1e3:.1f} ms"
+                     if ss else "")
+            print(f"-- {time.strftime('%H:%M:%S')} {args.run_dir}{extra}")
+            for rank, info in view["ranks"].items():
+                print(f"  rank {rank}: phase={info['phase']} "
+                      f"step={info['step']} "
+                      f"last span {info['last_span_age_s']}s ago")
+            if not view["ranks"]:
+                print("  (no span files yet)")
+        if not args.follow:
+            return 0
+        time.sleep(max(0.2, args.interval))
+
+
+# ------------------------------------------------------------------ smoke
+
+
+def _smoke_off_pin(out: Dict[str, Any]) -> bool:
+    """Leg 1: telemetry=off vs on must train bitwise-identically AND
+    lower byte-identical step programs — telemetry is host-side
+    bookkeeping, never program content."""
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from ray_lightning_tpu import DataLoader, Trainer
+    from ray_lightning_tpu.models.mlp import MLPClassifier
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    y = rng.integers(0, 4, size=(64,))
+
+    def _fit(telemetry):
+        trainer = Trainer(max_epochs=1, max_steps=4, seed=0,
+                          enable_checkpointing=False,
+                          enable_progress_bar=False,
+                          default_root_dir=tempfile.mkdtemp(
+                              prefix="rlt_offpin_"),
+                          telemetry=telemetry)
+        module = MLPClassifier(features=(16,), num_classes=4, lr=1e-2)
+        trainer.fit(module, DataLoader({"x": x, "y": y}, batch_size=16))
+        lowered = trainer._train_step._jitted.lower(
+            trainer.state, trainer._place_train_batch(
+                {"x": x[:16], "y": y[:16]})[1], trainer._base_rng)
+        return trainer.state.params, lowered.as_text()
+
+    params_off, text_off = _fit(False)
+    params_on, text_on = _fit(True)
+    identical = all(
+        bool(jax.numpy.array_equal(a, b))
+        for a, b in zip(jax.tree.leaves(params_off),
+                        jax.tree.leaves(params_on)))
+    out["off_pin"] = {
+        "params_bitwise_identical": identical,
+        "program_byte_identical": text_off == text_on,
+        "ok": identical and text_off == text_on,
+    }
+    return out["off_pin"]["ok"]
+
+
+def _smoke_goodput_leg(args, out: Dict[str, Any]) -> bool:
+    """Leg 2: 2-proc supervised CPU-SPMD fit, injected worker kill,
+    telemetry on — the goodput report must be parseable, sum to wall
+    within 5%, and show nonzero backoff + replay."""
+    import tempfile
+
+    from ray_lightning_tpu.resilience.cli import (
+        _smoke_data, _smoke_module, _smoke_trainer,
+    )
+    from ray_lightning_tpu.resilience.policy import RetryPolicy
+    from ray_lightning_tpu.resilience.supervisor import (
+        ResilienceConfig, fit_supervised,
+    )
+
+    base = tempfile.mkdtemp(prefix="rlt_monitor_smoke_")
+    cfg = ResilienceConfig(
+        checkpoint_dir=os.path.join(base, "ckpts"),
+        policy=RetryPolicy(max_restarts=2, backoff_base_s=0.5,
+                           jitter=0.0),
+        # save every 5 steps: a kill at step 3 resumes BEHIND the dead
+        # attempt's frontier, so the replay bucket is provably nonzero
+        save_every_n_steps=5,
+        heartbeat_interval_s=1.0,
+        stall_timeout_s=0.0,
+        faults="kill:rank=1,step=3",
+    )
+    leg: Dict[str, Any] = {"checkpoint_dir": base}
+    out["goodput_leg"] = leg
+    try:
+        supervised = fit_supervised(
+            _smoke_module, _smoke_trainer, _smoke_data, args.processes,
+            resilience=cfg, platform="cpu",
+            num_cpu_devices_per_process=1, return_weights=False,
+            timeout=args.timeout)
+    except Exception as exc:  # noqa: BLE001 — the gate reports, not raises
+        leg["ok"] = False
+        leg["error"] = f"{type(exc).__name__}: {str(exc)[:300]}"
+        return False
+    report = supervised.goodput
+    leg["restarts"] = supervised.restarts
+    leg["goodput"] = report
+    if not report:
+        leg["ok"] = False
+        leg["error"] = "supervisor assembled no goodput report"
+        return False
+    problems = []
+    if supervised.restarts < 1:
+        problems.append("injected kill never fired (0 restarts)")
+    if not gp.buckets_consistent(report, tolerance=0.05):
+        problems.append(
+            f"buckets sum {report['buckets_sum_s']}s != wall "
+            f"{report['wall_s']}s within 5%")
+    buckets = report["buckets"]
+    for cls in gp.LOST_CLASSES:
+        if buckets.get(cls, 0.0) <= 0.0:
+            problems.append(f"lost-time class {cls} is zero — the "
+                            "restart's cost went unattributed")
+    leg["ok"] = not problems
+    if problems:
+        leg["error"] = "; ".join(problems)
+    return leg["ok"]
+
+
+def _smoke_flagship_drift(args, out: Dict[str, Any]) -> bool:
+    """Leg 3: the flagship drift section must emit — predicted step
+    composition from tracecheck, measured slot a structured-skip
+    placeholder on a box with no TPU telemetry run to join."""
+    predicted = predicted_step_composition("llama3-8b",
+                                           args.flagship_topo)
+    drift = build_drift(predicted, timeline=None)
+    out["flagship_drift"] = drift
+    ok = ("error" not in predicted
+          and predicted.get("ici_time_us", 0) > 0
+          and isinstance(drift.get("measured"), dict)
+          and "skipped" in drift["measured"]
+          and drift.get("verdict") == "not-measured")
+    out["flagship_drift_ok"] = ok
+    return ok
+
+
+def _run_smoke(args) -> int:
+    out: Dict[str, Any] = {"gate": "monitor --smoke"}
+    ok = True
+    legs = (("off_pin", lambda: _smoke_off_pin(out)),
+            ("goodput", lambda: _smoke_goodput_leg(args, out)),
+            ("flagship_drift", lambda: _smoke_flagship_drift(args, out)))
+    for name, leg in legs:
+        try:
+            ok = leg() and ok
+        except Exception as exc:  # noqa: BLE001 — a crashed leg is a
+            # failed gate with a named cause, never a bare traceback
+            ok = False
+            out.setdefault("errors", []).append(
+                f"{name}: {type(exc).__name__}: {str(exc)[:300]}")
+    out["ok"] = ok
+    print(json.dumps(out) if getattr(args, "as_json", False)
+          else _smoke_text(out))
+    return 0 if ok else 1
+
+
+def _smoke_text(out: Dict[str, Any]) -> str:
+    lines = [f"monitor --smoke: {'ok' if out['ok'] else 'FAILED'}"]
+    op = out.get("off_pin") or {}
+    lines.append(f"  off-pin: {'ok' if op.get('ok') else 'FAILED'} "
+                 f"(params identical={op.get('params_bitwise_identical')}"
+                 f", program identical={op.get('program_byte_identical')})")
+    leg = out.get("goodput_leg") or {}
+    g = leg.get("goodput") or {}
+    lines.append(
+        f"  goodput: {'ok' if leg.get('ok') else 'FAILED'} "
+        f"(restarts={leg.get('restarts')}, "
+        f"wall={g.get('wall_s')}s, sum={g.get('buckets_sum_s')}s, "
+        f"backoff={((g.get('buckets') or {}).get('backoff_s'))}s, "
+        f"replay={((g.get('buckets') or {}).get('rollback_replay_s'))}s)"
+        + (f" — {leg.get('error')}" if leg.get("error") else ""))
+    lines.append(f"  flagship drift: "
+                 f"{'ok' if out.get('flagship_drift_ok') else 'FAILED'} "
+                 f"(verdict="
+                 f"{(out.get('flagship_drift') or {}).get('verdict')})")
+    for err in out.get("errors", ()):
+        lines.append(f"  error: {err}")
+    return "\n".join(lines)

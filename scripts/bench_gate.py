@@ -11,6 +11,15 @@ prior value is the per-metric max — speed can only go up:
     mfu                      model FLOPs utilization
     overlap_hidden_fraction  hidden share of prefetchable ICI time
                              (static, carried even on skip lines)
+    goodput_fraction         productive share of the headline
+                             measurement window (measured)
+
+Bounded metrics (upper limits, not ratchets):
+
+    telemetry_overhead_fraction  measured span-recorder cost relative
+                                 to the step time — must stay < 1%
+                                 (ISSUE 7: observability must not
+                                 become the overhead it measures)
 
 Gate semantics:
 
@@ -49,11 +58,21 @@ RATCHETED = {
     "tokens_per_sec_per_chip": "value",
     "mfu": "mfu",
     "overlap_hidden_fraction": "overlap_hidden_fraction",
+    "goodput_fraction": "goodput_fraction",
 }
 
 #: keys computed by static analysis (no hardware needed) — carried on
 #: backend-down skip lines and ratcheted there too, unlike measurements
 STATIC = {"overlap_hidden_fraction"}
+
+#: metric -> max allowed value on a measured (non-skip) line; absent or
+#: null waives (bench.py reports null when the probe itself failed) —
+#: the bound exists to stop telemetry from growing into real overhead,
+#: not to demand the field on every historic line
+BOUNDED = {
+    "telemetry_overhead_fraction": float(
+        os.environ.get("RLT_BENCH_TELEMETRY_OVERHEAD_MAX", 0.01)),
+}
 
 
 def _extract_line(obj: dict) -> Optional[dict]:
@@ -161,6 +180,22 @@ def gate(fresh: dict, best: dict, tolerance: float) -> list[str]:
                 f"{name}: {v:g} regressed below {floor:g} "
                 f"(best prior {prior:g} in {source}, "
                 f"tolerance {tolerance:.0%})")
+    for key, bound in BOUNDED.items():
+        if skipped:
+            continue  # bounds apply to measured lines only
+        v = fresh.get(key)
+        if v is None:
+            continue  # probe failed or pre-telemetry line: waived
+        try:
+            v = float(v)
+        except (TypeError, ValueError):
+            failures.append(f"{key}: non-numeric value {v!r}")
+            continue
+        if v > bound:
+            failures.append(
+                f"{key}: {v:g} exceeds the {bound:g} upper bound — "
+                "telemetry is eating the step time it exists to "
+                "measure")
     return failures
 
 

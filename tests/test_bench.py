@@ -164,12 +164,16 @@ def test_secondary_leg_failure_degrades_not_fatal(monkeypatch):
 
     def fake_measure(use_flash, fused_ce, batch, seq, vocab=32768,
                      remat=True, scan=True, remat_policy="nothing",
-                     ce_chunk_tokens=2048, ce_inline=False):
+                     ce_chunk_tokens=2048, ce_inline=False,
+                     timing=None):
         if vocab == 128256 and not remat:
             raise MemoryError("RESOURCE_EXHAUSTED: hbm")  # the v128k leg
         cfg = bench._bench_cfg(use_flash, fused_ce, seq, vocab, remat,
                                scan, remat_policy, ce_chunk_tokens,
                                ce_inline)
+        if timing is not None:
+            timing.update({"wall_s": 1.2, "productive_s": 1.0,
+                           "step_dt_s": 0.01})
         return 1000.0, cfg
 
     monkeypatch.setattr(bench, "_measure", fake_measure)
