@@ -408,6 +408,106 @@ def _overlap_summary(cfg, topology_for_kind) -> dict:
                 f"{type(exc).__name__}: {str(exc)[:200]}"}
 
 
+def _serve_summary() -> dict:
+    """Serving SCHEMA + the flagship serve plan for every JSON line
+    this process emits (ISSUE 8): byte math + one eval_shape, no
+    backend touch, so a backend-down skip line still carries the
+    serving memory story and tells the recorder what shape the
+    measured serving metrics (`decode_tokens_per_s`, `ttft_cold_s`,
+    `ttft_warm_s`, `slot_occupancy` — success lines only) will take."""
+    try:
+        import jax.numpy as jnp
+
+        from ray_lightning_tpu.models.llama import LlamaConfig
+        from ray_lightning_tpu.serve.audit import serve_memory_summary
+        from ray_lightning_tpu.serve.engine import EngineConfig
+
+        cfg = LlamaConfig.llama3_8b(max_seq_len=4096, dtype=jnp.bfloat16)
+        ecfg = EngineConfig(capacity=8, block_size=16,
+                            blocks_per_slot=256, prefill_chunk=256)
+        plan = serve_memory_summary(cfg, ecfg)
+        return {"serving": {
+            "schema": ["decode_tokens_per_s", "ttft_cold_s",
+                       "ttft_warm_s", "slot_occupancy"],
+            "engine": "paged-kv continuous-batching (serve/)",
+            "source": "static-schema",
+            "flagship_plan": plan,
+        }}
+    except Exception as exc:  # noqa: BLE001 — advisory data only
+        return {"serving_error": f"{type(exc).__name__}: {str(exc)[:200]}"}
+
+
+def _measure_serving(tiny: bool | None = None) -> dict:
+    """Measured serving leg (bench success lines + unit tests).
+
+    ``tiny=None`` auto-sizes: the 0.5B-class bench model on an
+    accelerator, the laptop-sized tiny config on CPU (unit tests /
+    RLT_BENCH_SERVE_TINY=1) — same engine code path either way.
+    """
+    import time as _time
+
+    import jax
+
+    from ray_lightning_tpu.models.llama import Llama, LlamaConfig
+    from ray_lightning_tpu.serve.engine import DecodeEngine, EngineConfig
+    from ray_lightning_tpu.serve.scheduler import Request, Scheduler
+
+    if tiny is None:
+        tiny = (jax.default_backend() == "cpu"
+                or os.environ.get("RLT_BENCH_SERVE_TINY") == "1")
+    if tiny:
+        import jax.numpy as jnp
+
+        cfg = LlamaConfig.tiny(use_flash=False, dtype=jnp.float32)
+        ecfg = EngineConfig(capacity=4, block_size=4, blocks_per_slot=8,
+                            prefill_chunk=4)
+        prompt_len, max_new, n_requests = 6, 8, 8
+    else:
+        cfg = _bench_cfg(use_flash=True, fused_ce=False, seq=1024,
+                         remat=False, scan=False)
+        ecfg = EngineConfig(capacity=8, block_size=16,
+                            blocks_per_slot=64, prefill_chunk=128)
+        prompt_len, max_new, n_requests = 128, 64, 16
+    model = Llama(cfg)
+    prompt = np.asarray(jax.random.randint(
+        jax.random.key(0), (1, prompt_len), 0, cfg.vocab_size),
+        dtype=np.int32)
+    params = jax.jit(model.init)(jax.random.key(1), prompt)["params"]
+
+    def first_token_wall(engine) -> float:
+        sched = Scheduler(engine)
+        sched.submit(Request(rid="ttft", prompt=prompt[0],
+                             max_new_tokens=1))
+        t0 = _time.perf_counter()
+        while sched.busy():
+            sched.tick()
+        return _time.perf_counter() - t0
+
+    # TTFT cold: fresh engine, no warmup — the compile is the latency
+    engine = DecodeEngine(model, params, ecfg)
+    ttft_cold = first_token_wall(engine)
+    # TTFT warm: the same compiled engine, a fresh request
+    ttft_warm = first_token_wall(engine)
+    # steady-state decode throughput, slots saturated
+    sched = Scheduler(engine)
+    for i in range(n_requests):
+        sched.submit(Request(rid=f"r{i}", prompt=prompt[0],
+                             max_new_tokens=max_new, seed=i))
+    t0 = _time.perf_counter()
+    n_tokens = 0
+    while sched.busy():
+        sched.tick()
+        n_tokens += len(sched.last_emissions)
+    wall = _time.perf_counter() - t0
+    return {
+        "decode_tokens_per_s": round(n_tokens / max(wall, 1e-9), 2),
+        "ttft_cold_s": round(ttft_cold, 4),
+        "ttft_warm_s": round(ttft_warm, 4),
+        "slot_occupancy": round(sched.slot_occupancy, 4),
+        "serving_compile_count": engine.compile_count,
+    }
+
+
 def _kill_line(signame: str) -> str:
     """The structured line a driver kill flushes before death: same
     schema as the watchdog/skip lines — ONE parseable JSON object, with
@@ -626,6 +726,7 @@ def main() -> None:
     _ANALYSIS.update(_trace_summary())
     _ANALYSIS.update(_guard_summary())
     _ANALYSIS.update(_telemetry_summary())
+    _ANALYSIS.update(_serve_summary())
 
     # Watchdog: a wedged device tunnel (observed on shared-chip setups:
     # every op, even jax.devices(), blocks forever) must surface as an
@@ -921,6 +1022,17 @@ def _run(sink: dict | None = None) -> dict:
                 "compile_warm_s": r["compile_warm_s"],
                 "overlap": r}
 
+    def _serving():
+        # serving leg (serve/, docs/SERVING.md, ISSUE 8): the real
+        # continuous-batching engine on THIS backend. TTFT cold = first
+        # request through a FRESH engine including the step compile
+        # (the P99 story a persistent compile cache improves); TTFT
+        # warm = a later request on the compiled engine (pure
+        # queue+prefill); decode throughput at steady state with every
+        # slot occupied. Random weights: serving throughput is
+        # content-independent.
+        return _measure_serving()
+
     leg("vs_baseline", _baseline)
     leg("s4096", _s4k)
     leg("v128k", _v128k)
@@ -928,6 +1040,7 @@ def _run(sink: dict | None = None) -> dict:
     leg("flagship", _flagship)
     leg("flagship_attnout", _flagship_attnout)
     leg("overlap", _overlap)
+    leg("serving", _serving)
 
     # Self-consistency (VERDICT r3 weak #1): the probe is a THROUGHPUT
     # ceiling; any model leg reading more effective FLOP/s than the bare

@@ -89,6 +89,16 @@ JAX_PLATFORMS=cpu python -m ray_lightning_tpu supervise --smoke > /dev/null
 # composition.
 JAX_PLATFORMS=cpu python -m ray_lightning_tpu monitor --smoke > /dev/null
 
+# serving gate (docs/SERVING.md): 8 concurrent staggered streams
+# (ragged prompts, mixed greedy/temperature/top-k) through the
+# continuous-batching engine must decode bitwise-identical to 8
+# independent single-stream generate() runs; request churn must compile
+# the step exactly ONCE; with 2 process replicas an injected SIGKILL
+# mid-stream must classify -> respawn -> reload weights -> replay the
+# lost streams bitwise with the survivor untouched; and the decode step
+# must audit clean under tracecheck (no RLT301/RLT303).
+JAX_PLATFORMS=cpu python -m ray_lightning_tpu serve --smoke > /dev/null
+
 # prefetch-overlap + collective-overlap smoke: a slow-loader CPU run
 # must show pipeline occupancy > 0 (the device prefetcher demonstrably
 # kept batches resident ahead of the step), the overlap jaxpr must

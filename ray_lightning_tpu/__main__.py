@@ -48,6 +48,16 @@ injected parameter bit-flip must quarantine the rank):
     python -m ray_lightning_tpu supervise my_project.jobs:make_job \\
         --processes 4 --max-restarts 3
 
+``serve`` runs the continuous-batching inference engine (serve/,
+docs/SERVING.md): a paged-KV decode engine multiplexed over replica
+groups, with ``--smoke`` as the format.sh gate (8 concurrent streams
+bitwise-identical to single-stream generate(), churn compiles once, an
+injected replica SIGKILL auto-recovers, decode step audits clean):
+
+    python -m ray_lightning_tpu serve example --replicas 2
+    python -m ray_lightning_tpu serve llama3-8b --topo v5p-8
+    python -m ray_lightning_tpu serve --smoke
+
 ``report`` / ``monitor`` read the telemetry a run left behind
 (telemetry/, docs/OBSERVABILITY.md): the goodput classification of
 supervised wall time, per-rank span timelines, and — with
@@ -184,6 +194,82 @@ def _print_trace_section(trace: dict) -> None:
               f"{f['message']}")
 
 
+def _run_serve_plan(args) -> int:
+    """``plan --serve``: the serving replica's HBM story (no optimizer
+    — weights + paged KV pool + the step's dense gathered view +
+    carried logits) with the decode-step tracecheck section. Same exit
+    contract as the training plan: 0 fits, 1 does not, 2 invalid."""
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.models.llama import LlamaConfig
+    from ray_lightning_tpu.serve.audit import (
+        audit_decode_step,
+        format_serve_summary,
+        serve_memory_summary,
+    )
+    from ray_lightning_tpu.serve.engine import EngineConfig
+
+    for name in ("serve_slots", "serve_block_size"):
+        if getattr(args, name) < 1:
+            return _plan_invalid(
+                f"--{name.replace('_', '-')} must be >= 1, got "
+                f"{getattr(args, name)}", args.as_json)
+    presets = {
+        "llama3-8b": LlamaConfig.llama3_8b,
+        "tiny": LlamaConfig.tiny,
+    }
+    cfg = presets[args.preset](max_seq_len=args.seq, dtype=jnp.bfloat16)
+    bps = -(-args.seq // args.serve_block_size)
+    try:
+        ecfg = EngineConfig(
+            capacity=args.serve_slots,
+            block_size=args.serve_block_size, blocks_per_slot=bps,
+            prefill_chunk=min(max(128, args.serve_block_size),
+                              args.seq))
+        summary = serve_memory_summary(
+            cfg, ecfg, device_kind=args.device_kind,
+            hbm_bytes=args.hbm_bytes)
+    except ValueError as exc:
+        return _plan_invalid(str(exc), args.as_json)
+    trace = None
+    if not args.no_trace:
+        try:
+            from ray_lightning_tpu.analysis.costmodel import (
+                topology_for_kind,
+            )
+
+            topo = topology_for_kind(args.device_kind, 1,
+                                     hbm_bytes=args.hbm_bytes)
+            report = audit_decode_step(cfg, ecfg, topology=topo,
+                                       label=f"{args.preset} serve")
+            trace = {
+                "peak_hbm_bytes": report.peak_hbm_bytes,
+                "hbm_budget_bytes": report.hbm_budget_bytes,
+                "findings": [f.to_dict() for f in report.findings],
+            }
+        except Exception as exc:  # noqa: BLE001 — advisory section only
+            trace = {"trace_error":
+                     f"{type(exc).__name__}: {str(exc)[:300]}"}
+    if args.as_json:
+        out = {"serve": summary, "fits": summary["fits"]}
+        if trace is not None:
+            out["trace"] = trace
+        print(json.dumps(out))
+    else:
+        print(format_serve_summary(summary))
+        if trace is not None:
+            if "trace_error" in trace:
+                print(f"tracecheck: unavailable ({trace['trace_error']})")
+            else:
+                gib = 1024**3
+                rules = sorted({f["rule"] for f in trace["findings"]})
+                print(f"tracecheck (decode step): liveness peak "
+                      f"{trace['peak_hbm_bytes'] / gib:.2f} GiB vs "
+                      f"budget {trace['hbm_budget_bytes'] / gib:.2f} "
+                      f"GiB; findings: {rules if rules else 'none'}")
+    return 0 if summary["fits"] else 1
+
+
 def run_plan(args) -> int:
     import numpy as np
 
@@ -202,6 +288,8 @@ def run_plan(args) -> int:
         "llama3-8b": LlamaConfig.llama3_8b,
         "tiny": LlamaConfig.tiny,
     }
+    if args.serve:
+        return _run_serve_plan(args)
     # --find-max-batch ignores --batch entirely, including its validation
     checked = ("data", "fsdp", "tensor", "seq") if args.find_max_batch \
         else ("data", "fsdp", "tensor", "batch", "seq")
@@ -385,6 +473,17 @@ def main(argv=None) -> int:
                         help="plan with a bf16 Adam first moment "
                              "(mu_dtype=bfloat16 — halves the mu buffer; "
                              "the planner charges the real dtype)")
+    plan_p.add_argument("--serve", action="store_true",
+                        help="plan a SERVING replica instead of a "
+                             "training step: weights + paged KV pool + "
+                             "gathered view vs the chip budget, with "
+                             "the decode-step tracecheck section "
+                             "(docs/SERVING.md)")
+    plan_p.add_argument("--serve-slots", type=int, default=8,
+                        help="serving slot capacity (plan --serve)")
+    plan_p.add_argument("--serve-block-size", type=int, default=16,
+                        help="KV pool block size in tokens "
+                             "(plan --serve)")
     plan_p.add_argument("--find-max-batch", action="store_true",
                         help="ignore --batch and report the largest "
                              "per-device batch (and the implied global "
@@ -407,6 +506,7 @@ def main(argv=None) -> int:
     from ray_lightning_tpu.resilience.cli import (
         add_supervise_parser, run_supervise,
     )
+    from ray_lightning_tpu.serve.cli import add_serve_parser, run_serve
     from ray_lightning_tpu.telemetry.report import (
         add_monitor_parser, add_report_parser, run_monitor, run_report,
     )
@@ -415,6 +515,7 @@ def main(argv=None) -> int:
     add_trace_parser(sub)
     add_supervise_parser(sub)
     add_perf_parser(sub)
+    add_serve_parser(sub)
     add_report_parser(sub)
     add_monitor_parser(sub)
     args = p.parse_args(argv)
@@ -428,6 +529,8 @@ def main(argv=None) -> int:
         return run_supervise(args)
     if args.cmd == "perf":
         return run_perf(args)
+    if args.cmd == "serve":
+        return run_serve(args)
     if args.cmd == "report":
         return run_report(args)
     if args.cmd == "monitor":

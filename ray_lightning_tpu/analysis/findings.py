@@ -143,6 +143,15 @@ RULES: Dict[str, Rule] = {r.id: r for r in (
          "append in a per-batch Callback hook with no ring/truncation/"
          "flush anywhere in the class (the list grows for the life of "
          "the run; use a deque(maxlen=...) or truncate)"),
+    Rule("RLT502", "serve-loop-recompile", "warning",
+         "a decode/serve loop calls a jitted function with a "
+         "Python-varying shape (a sequence buffer grown by concatenate "
+         "every iteration, or an argument sliced to an un-bucketed "
+         "per-iteration length): every call silently retraces and "
+         "recompiles, turning request churn into a compile storm. "
+         "Keep device shapes fixed — decode into a position-indexed "
+         "KV cache, pad prompts to buckets, or use the fixed-capacity "
+         "slot engine (serve.DecodeEngine, docs/SERVING.md)"),
 )}
 
 

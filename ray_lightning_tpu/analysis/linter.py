@@ -1062,6 +1062,205 @@ class _TelemetryCallbackLint:
                 call, cls.name)
 
 
+# ---- RLT502: serve-loop recompile -----------------------------------------
+#
+# The classic serving trap: a decode loop that calls a jitted function
+# with a Python-varying shape — a sequence buffer grown by concatenate
+# every iteration, or a prompt sliced to its un-bucketed length — so
+# EVERY request (or every token) silently retraces and recompiles. The
+# rule is deliberately narrow: the callee must be jit-wrapped in this
+# file, and the argument must provably change shape across iterations
+# of the enclosing loop.
+
+#: growth constructors: `x = <ns>.concatenate([x, ...])` and friends
+#: rebind x to a longer buffer every trip
+_RLT502_GROWERS: Set[str] = {
+    "concatenate", "append", "hstack", "vstack", "column_stack",
+    "stack", "r_", "pad",
+}
+
+
+def _rlt502_is_jit_expr(expr: ast.AST) -> bool:
+    """jax.jit / jit / partial(jax.jit, ...) (as decorator or call)."""
+    name = _dotted(expr)
+    if name is not None:
+        return name.split(".")[-1] == "jit"
+    if isinstance(expr, ast.Call):
+        fname = _dotted(expr.func) or ""
+        if fname.split(".")[-1] == "partial" and expr.args:
+            return _rlt502_is_jit_expr(expr.args[0])
+        return _rlt502_is_jit_expr(expr.func)
+    return False
+
+
+def _rlt502_jitted_names(tree: ast.Module) -> Set[str]:
+    """Local names known to be jit-compiled callables: decorated defs
+    and `name = jax.jit(...)`-style assignments."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_rlt502_is_jit_expr(d) for d in node.decorator_list):
+                names.add(node.name)
+        elif isinstance(node, ast.Assign) and isinstance(node.value,
+                                                        ast.Call):
+            if (_rlt502_is_jit_expr(node.value.func)
+                    or _rlt502_is_jit_expr(node.value)):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+    return names
+
+
+def _rlt502_own_loop_nodes(loop: ast.AST) -> Iterable[ast.AST]:
+    """One loop's body nodes, excluding nested defs AND nested loops
+    (each nested loop is linted as its own loop)."""
+    stack: List[ast.AST] = list(loop.body) + list(
+        getattr(loop, "orelse", []))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.For, ast.While)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _rlt502_growing_names(own: List[ast.AST]) -> Set[str]:
+    """Names rebound inside the loop from a concatenate/append/... of
+    THEMSELVES — a buffer that grows every iteration."""
+    grow: Set[str] = set()
+    for node in own:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not isinstance(node.value, ast.Call):
+            continue
+        callee = (_dotted(node.value.func) or "").split(".")[-1]
+        if callee not in _RLT502_GROWERS:
+            continue
+        used = {n.id for n in ast.walk(node.value)
+                if isinstance(n, ast.Name)}
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id in used:
+                grow.add(t.id)
+    return grow
+
+
+def _rlt502_varying_names(loop: ast.AST, own: List[ast.AST]) -> Set[str]:
+    """Names whose VALUE changes per iteration: the for target plus
+    anything (re)assigned in the loop body."""
+    vary: Set[str] = set()
+    if isinstance(loop, ast.For):
+        vary |= {n.id for n in ast.walk(loop.target)
+                 if isinstance(n, ast.Name)}
+    for node in own:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        vary.add(n.id)
+        elif isinstance(node, ast.AugAssign) and isinstance(node.target,
+                                                            ast.Name):
+            vary.add(node.target.id)
+    return vary
+
+
+def _rlt502_varying_slice(arg: ast.AST, vary: Set[str]) -> Optional[str]:
+    """A slice bound inside ``arg`` that references a loop-varying name
+    (``x[:t]`` — shape changes per trip). Integer INDEXING (``x[t]``)
+    keeps the shape constant and never fires."""
+    for node in ast.walk(arg):
+        if not isinstance(node, ast.Subscript):
+            continue
+        slices = (node.slice.elts if isinstance(node.slice, ast.Tuple)
+                  else [node.slice])
+        for sl in slices:
+            if not isinstance(sl, ast.Slice):
+                continue
+            for bound in (sl.lower, sl.upper):
+                if bound is None:
+                    continue
+                for n in ast.walk(bound):
+                    if isinstance(n, ast.Name) and n.id in vary:
+                        return n.id
+    return None
+
+
+class _ServeLoopLint:
+    """RLT502 driver: every for/while loop in NON-traced code (a loop
+    under a tracer has static shapes by construction) that calls a
+    known-jitted function with a per-iteration-varying shape."""
+
+    def __init__(self, lint: _FileLint):
+        self.lint = lint
+
+    def _lint_loop(self, loop: ast.AST, jitted: Set[str],
+                   symbol: Optional[str],
+                   outer_vary: Set[str]) -> None:
+        own = list(_rlt502_own_loop_nodes(loop))
+        grow = _rlt502_growing_names(own)
+        # an ENCLOSING loop's targets vary per iteration here too: the
+        # canonical per-request-outer / per-token-inner serve loop
+        # slices by the outer loop's un-bucketed length
+        # (`for l in lens: while ...: step(params, toks[:, :l])`)
+        vary = _rlt502_varying_names(loop, own) | outer_vary
+        for node in own:
+            if not isinstance(node, ast.Call):
+                continue
+            if not (isinstance(node.func, ast.Name)
+                    and node.func.id in jitted):
+                continue
+            for arg in list(node.args) + [kw.value for kw in
+                                          node.keywords]:
+                if isinstance(arg, ast.Name) and arg.id in grow:
+                    detail = (f"{arg.id!r} is grown by concatenate/"
+                              "append inside the loop")
+                elif (sliced := _rlt502_varying_slice(arg, vary)) \
+                        is not None:
+                    detail = (f"sliced by loop-varying {sliced!r}")
+                else:
+                    continue
+                self.lint.add(
+                    "RLT502",
+                    f"jitted {node.func.id}() is called in this loop "
+                    f"with an argument whose shape changes every "
+                    f"iteration ({detail}): each call silently "
+                    "retraces AND recompiles — the classic serve-loop "
+                    "trap (growing sequence axis / un-bucketed prompt "
+                    "lengths). Keep device shapes fixed: decode into a "
+                    "position-indexed KV cache (models.llama.generate), "
+                    "pad prompts to a bucket, or serve through the "
+                    "fixed-capacity slot engine (serve.DecodeEngine, "
+                    "docs/SERVING.md)", node, symbol)
+                break
+
+    def run(self, tree: ast.Module, funcs: List["_Func"]) -> None:
+        jitted = _rlt502_jitted_names(tree)
+        if not jitted:
+            return
+        traced_nodes = {id(fn.node) for fn in funcs if fn.traced}
+
+        def walk(stmts, symbol, outer_vary: Set[str]):
+            for node in stmts:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    if id(node) not in traced_nodes:
+                        walk(node.body, node.name, set())
+                    continue
+                if isinstance(node, ast.Lambda):
+                    continue
+                if isinstance(node, (ast.For, ast.While)):
+                    self._lint_loop(node, jitted, symbol, outer_vary)
+                    inner_vary = outer_vary | _rlt502_varying_names(
+                        node, list(_rlt502_own_loop_nodes(node)))
+                    walk(list(node.body) + list(node.orelse), symbol,
+                         inner_vary)
+                    continue
+                walk(list(ast.iter_child_nodes(node)), symbol,
+                     outer_vary)
+
+        walk(tree.body, None, set())
+
+
 def lint_source(source: str, filename: str = "<string>",
                 extra_axes: Sequence[str] = ()) -> List[Finding]:
     """Lint one file's source text. Never imports the target."""
@@ -1120,6 +1319,7 @@ def lint_source(source: str, filename: str = "<string>",
     # non-traced code (a loop under a tracer is RLT201's scope)
     _HotLoopLint(lint).run(tree, coll.funcs)
     _TelemetryCallbackLint(lint).run(tree)
+    _ServeLoopLint(lint).run(tree, coll.funcs)
     return lint.findings
 
 

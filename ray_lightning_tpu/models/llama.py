@@ -212,11 +212,16 @@ class LlamaBlock(nn.Module):
     mesh: Optional[Any] = None  # jax.sharding.Mesh (static, hashable)
 
     @nn.compact
-    def __call__(self, x, cos, sin, cache=None, pos=None):
+    def __call__(self, x, cos, sin, cache=None, pos=None, pad=None):
         """Training/prefill-from-zero when cache is None; with a
         ``cache=(k_cache, v_cache)`` ([B, S_max, Hkv, hd] each) and a
         (traced) ``pos``, runs the KV-cache decode path and returns the
-        updated cache as the scan output."""
+        updated cache as the scan output. ``pad`` ([B] int32, cache path
+        only) is the per-row LEFT padding of a ragged batch: RoPE
+        positions shift down by ``pad[b]`` (clamped at 0 for the pad
+        rows themselves, whose outputs are discarded) and attention
+        masks out the pad columns — a left-padded row decodes exactly
+        like its unpadded prompt (test-pinned)."""
         cfg = self.cfg
         d, hd = cfg.dim, cfg.head_dim
         dense = partial(nn.Dense, use_bias=False, dtype=cfg.dtype,
@@ -274,6 +279,12 @@ class LlamaBlock(nn.Module):
             new_cache = None
         else:
             positions = pos + jnp.arange(S)
+            if pad is not None:
+                # left-padded ragged batch: row b's first real token
+                # sits at column pad[b] but is RoPE position 0; clamp
+                # keeps the (discarded) pad rows' table reads in range
+                positions = jnp.maximum(
+                    positions[None, :] - pad[:, None], 0)
             q = apply_rope(q, cos, sin, positions=positions)
             k = apply_rope(k, cos, sin, positions=positions)
             ck, cv = cache  # [B, S_max, Hkv, hd]
@@ -281,7 +292,8 @@ class LlamaBlock(nn.Module):
                 ck, k.astype(ck.dtype), pos, axis=1)
             cv = jax.lax.dynamic_update_slice_in_dim(
                 cv, v.astype(cv.dtype), pos, axis=1)
-            if S > 1 and isinstance(pos, int) and pos == 0:
+            if (S > 1 and isinstance(pos, int) and pos == 0
+                    and pad is None):
                 # prefill from empty context: plain causal attention over
                 # the chunk itself (flash path — never materialize the
                 # [S, S_max] masked score matrix against the zero tail).
@@ -289,12 +301,17 @@ class LlamaBlock(nn.Module):
                     q, k, v, causal=True,
                     use_pallas=None if cfg.use_flash else False)
             else:
-                # single-token decode (or mid-sequence chunk): masked
-                # reference SDPA over the cache — S is tiny here.
+                # single-token decode (or mid-sequence chunk, or a
+                # left-padded prefill): masked reference SDPA over the
+                # cache — S is tiny here.
                 kv_pos = jnp.arange(ck.shape[1])[None, None, None, :]
                 q_pos = (pos + jnp.arange(S))[None, None, :, None]
+                mask = kv_pos <= q_pos
+                if pad is not None:
+                    # pad columns are not context for anyone
+                    mask = mask & (kv_pos >= pad[:, None, None, None])
                 attn = dot_product_attention(
-                    q, ck, cv, causal=False, mask=kv_pos <= q_pos)
+                    q, ck, cv, causal=False, mask=mask)
             new_cache = (ck, cv)
         attn = attn.reshape(B, S, n_q * hd)
         x = x + dense(d, name="wo")(attn)
@@ -316,7 +333,8 @@ class Llama(nn.Module):
 
     @nn.compact
     def __call__(self, tokens: jnp.ndarray, cache=None, pos=None,
-                 last_only: bool = False, return_hidden: bool = False):
+                 pad=None, last_only: bool = False,
+                 return_hidden: bool = False):
         """Training/eval: ``model(tokens) -> logits``. Decoding:
         ``model(tokens, cache=(k, v), pos=p) -> (logits, new_cache)``
         with cache leaves stacked over layers ([L, B, S_max, Hkv, hd];
@@ -359,16 +377,18 @@ class Llama(nn.Module):
                 # cache collected as the scan output (out_axes=0).
                 x, new_cache = scan(
                     block,
-                    in_axes=(nn.broadcast, nn.broadcast, 0, nn.broadcast),
+                    in_axes=(nn.broadcast, nn.broadcast, 0,
+                             nn.broadcast, nn.broadcast),
                     out_axes=0,
-                )(cfg, self.mesh, name="layers")(x, cos, sin, cache, pos)
+                )(cfg, self.mesh, name="layers")(x, cos, sin, cache,
+                                                 pos, pad)
         else:
             caches = []
             for i in range(cfg.n_layers):
                 layer_cache = None if cache is None else jax.tree.map(
                     lambda c, i=i: c[i], cache)
                 x, c = block(cfg, self.mesh, name=f"layer_{i}")(
-                    x, cos, sin, layer_cache, pos)
+                    x, cos, sin, layer_cache, pos, pad)
                 caches.append(c)
             if cache is not None:
                 new_cache = jax.tree.map(
@@ -468,12 +488,16 @@ def init_cache(cfg: LlamaConfig, batch: int, max_len: int):
 
 @functools.lru_cache(maxsize=32)
 def _compiled_generate(model: Llama, B: int, S0: int, max_new_tokens: int,
-                       temperature: float, top_k: Optional[int]):
+                       temperature: float, top_k: Optional[int],
+                       cache_len: int, padded: bool):
     """Build-and-jit once per (model, shape, sampling) key so repeated
     generate() calls hit XLA's compile cache instead of retracing a
-    fresh closure every time."""
+    fresh closure every time. The KV cache is an ARGUMENT, donated:
+    the caller's `init_cache` buffer is consumed in place, so the
+    decode holds one cache in HBM, never an input copy next to the
+    updated one (the second-full-cache failure mode this signature
+    retires)."""
     cfg = model.cfg
-    max_len = S0 + max_new_tokens
 
     def sample(logits, rng):
         if temperature == 0.0:
@@ -484,10 +508,10 @@ def _compiled_generate(model: Llama, B: int, S0: int, max_new_tokens: int,
             logits = jnp.where(logits >= kth, logits, -jnp.inf)
         return jax.random.categorical(rng, logits).astype(jnp.int32)
 
-    def run(params, prompt, rng):
-        cache = init_cache(cfg, B, max_len)
+    def run(params, prompt, rng, cache, pad):
         logits, cache = model.apply({"params": params}, prompt,
-                                    cache=cache, pos=0, last_only=True)
+                                    cache=cache, pos=0, pad=pad,
+                                    last_only=True)
         last = logits[:, -1, :]
         out = jnp.zeros((B, max_new_tokens), jnp.int32)
 
@@ -498,14 +522,25 @@ def _compiled_generate(model: Llama, B: int, S0: int, max_new_tokens: int,
             out = jax.lax.dynamic_update_slice_in_dim(
                 out, tok[:, None], t, axis=1)
             logits, cache = model.apply({"params": params}, tok[:, None],
-                                        cache=cache, pos=S0 + t)
+                                        cache=cache, pos=S0 + t, pad=pad)
             return (logits[:, 0, :], cache, out, rng)
 
-        _, _, out, _ = jax.lax.fori_loop(
+        _, cache, out, _ = jax.lax.fori_loop(
             0, max_new_tokens, body, (last, cache, out, rng))
-        return out
+        # the final cache is RETURNED so the donated input has an
+        # output to alias — donation with no matching output is a
+        # silent no-op (plus a UserWarning per compile); the caller
+        # drops it, the buffer is simply reused in place
+        return out, cache
 
-    return jax.jit(run)
+    if not padded:
+        # the pad argument must not appear in the unpadded program at
+        # all (bitwise pin vs the historical path)
+        def run_nopad(params, prompt, rng, cache):
+            return run(params, prompt, rng, cache, None)
+
+        return jax.jit(run_nopad, donate_argnums=(3,))
+    return jax.jit(run, donate_argnums=(3,))
 
 
 def generate(
@@ -516,25 +551,64 @@ def generate(
     temperature: float = 0.0,
     top_k: Optional[int] = None,
     seed: int = 0,
+    cache_len: Optional[int] = None,
+    prompt_lengths=None,
 ) -> jnp.ndarray:
     """Autoregressive decoding with a KV cache, one compiled program:
     flash-attention prefill over the prompt (one row of lm_head logits),
     then a `lax.fori_loop` of single-token steps (each an in-place
-    `dynamic_update_slice` into the cache — static shapes throughout, no
-    per-token recompilation; repeated calls reuse the compiled program).
+    `dynamic_update_slice` into the DONATED cache — static shapes
+    throughout, no per-token recompilation, one cache's HBM; repeated
+    calls reuse the compiled program).
 
     Greedy when temperature == 0; otherwise temperature (+ optional
-    top-k) sampling. Returns [B, max_new_tokens] int32.
+    top-k) sampling. ``cache_len`` sizes the KV cache explicitly (any
+    length >= prompt + max_new_tokens — no rounding is imposed);
+    default is exactly prompt + max_new_tokens. ``prompt_lengths``
+    ([B] ints) declares a LEFT-padded ragged batch: row b's real prompt
+    is its last ``prompt_lengths[b]`` columns, and each row decodes
+    exactly as its unpadded prompt would (test-pinned). Returns
+    [B, max_new_tokens] int32.
     """
     B, S0 = prompt.shape
-    if S0 + max_new_tokens > model.cfg.max_seq_len:
+    explicit_cache_len = cache_len is not None
+    if cache_len is None:
+        cache_len = S0 + max_new_tokens
+    if cache_len < S0 + max_new_tokens:
         raise ValueError(
-            f"prompt ({S0}) + max_new_tokens ({max_new_tokens}) exceeds "
-            f"max_seq_len ({model.cfg.max_seq_len})"
+            f"cache_len ({cache_len}) is smaller than prompt ({S0}) + "
+            f"max_new_tokens ({max_new_tokens})"
         )
+    if cache_len > model.cfg.max_seq_len:
+        what = (f"cache_len ({cache_len})" if explicit_cache_len else
+                f"prompt ({S0}) + max_new_tokens ({max_new_tokens})")
+        raise ValueError(
+            f"{what} exceeds max_seq_len ({model.cfg.max_seq_len})"
+        )
+    pad = None
+    if prompt_lengths is not None:
+        lengths = np.asarray(prompt_lengths, np.int32)
+        if lengths.shape != (B,):
+            raise ValueError(
+                f"prompt_lengths must have shape ({B},), got "
+                f"{lengths.shape}")
+        if (lengths < 1).any() or (lengths > S0).any():
+            # a length beyond the prompt width would produce a NEGATIVE
+            # pad — RoPE positions silently shift up and every decode
+            # is wrong with no error
+            raise ValueError(
+                f"prompt_lengths must be within [1, {S0}] (the padded "
+                f"prompt width), got {lengths.tolist()}")
+        pad = jnp.asarray(S0 - lengths)
     run = _compiled_generate(model, B, S0, max_new_tokens,
-                             float(temperature), top_k)
-    return run(params, prompt, jax.random.key(seed))
+                             float(temperature), top_k, int(cache_len),
+                             pad is not None)
+    cache = init_cache(model.cfg, B, cache_len)
+    if pad is None:
+        out, _ = run(params, prompt, jax.random.key(seed), cache)
+    else:
+        out, _ = run(params, prompt, jax.random.key(seed), cache, pad)
+    return out
 
 
 class LlamaModule(TpuModule):

@@ -1,0 +1,36 @@
+"""Inference/serving subsystem: continuous-batching decode engine on a
+block-paged KV cache, multiplexed across replica groups (docs/SERVING.md).
+
+Layers:
+  * kv_cache.py   — the paged pool + slot block tables + host allocator
+  * engine.py     — ONE jitted continuous-batching step (decode lane for
+                    every slot + a cond-gated prefill-chunk lane), fixed
+                    shapes so request churn never recompiles
+  * scheduler.py  — host-side slot lifecycle: admission queue, block
+                    reservation/growth, retirement, preemption
+  * driver.py     — request multiplexing over replica groups (inline or
+                    runtime.WorkerGroup processes) with supervised
+                    respawn + deterministic replay on replica death
+  * audit.py      — tracecheck audit of the decode step + the serving
+                    HBM plan leg
+  * cli.py        — ``python -m ray_lightning_tpu serve`` (+ --smoke)
+"""
+from ray_lightning_tpu.serve.engine import DecodeEngine, EngineConfig
+from ray_lightning_tpu.serve.kv_cache import (
+    BlockAllocator,
+    PagedPoolSpec,
+    init_pool,
+    serve_kv_plan_bytes,
+)
+from ray_lightning_tpu.serve.scheduler import Request, Scheduler
+
+__all__ = [
+    "BlockAllocator",
+    "DecodeEngine",
+    "EngineConfig",
+    "PagedPoolSpec",
+    "Request",
+    "Scheduler",
+    "init_pool",
+    "serve_kv_plan_bytes",
+]

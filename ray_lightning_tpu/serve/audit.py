@@ -1,0 +1,171 @@
+"""Static analysis of the serving engine: tracecheck the decode step,
+price the paged cache in HBM — zero devices, CPU-host safe.
+
+Two consumers:
+
+  * ``plan --serve`` (the serve-aware plan leg): a serving replica's
+    HBM story — params + paged pool + the dense gathered view the
+    reference step materializes + the carried logits buffer — against
+    the chip budget, plus the jaxpr-level audit of the step itself;
+  * the test/format.sh gates: the decode step must audit CLEAN — the
+    paged-attention gather is an explicit, position-masked table lookup
+    and must never read as an implicit reshard (RLT301), and the step
+    contains no ring collectives to deadlock (RLT303).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_lightning_tpu.analysis.costmodel import Topology, parse_topology
+from ray_lightning_tpu.serve.engine import EngineConfig, build_step
+from ray_lightning_tpu.serve.kv_cache import serve_kv_plan_bytes
+
+
+def trace_decode_step(model_cfg, engine_cfg: EngineConfig):
+    """``(closed_jaxpr, meta)`` for the engine's continuous-batching
+    step over abstract inputs — the exact program `DecodeEngine` jits,
+    traced with `eval_shape`/`make_jaxpr` so no backend initializes."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_lightning_tpu.models.llama import Llama
+
+    model = Llama(model_cfg)
+    step = build_step(model, engine_cfg)
+    spec = engine_cfg.pool_spec
+    C, CH = engine_cfg.capacity, engine_cfg.prefill_chunk
+    s = jax.ShapeDtypeStruct
+    a_tok = np.zeros((1, 2), np.int32)
+    a_params = jax.eval_shape(
+        lambda k: model.init(k, a_tok)["params"],
+        jax.eval_shape(lambda: jax.random.key(0)))
+    pool = s((model_cfg.n_layers, spec.n_blocks, spec.block_size,
+              model_cfg.n_kv_heads, model_cfg.head_dim),
+             jnp.dtype(model_cfg.dtype))
+    args = (
+        a_params, pool, pool,
+        s((C, model_cfg.vocab_size), jnp.float32),       # last_logits
+        s((C, spec.blocks_per_slot), jnp.int32),         # tables
+        s((C,), jnp.int32), s((C,), jnp.bool_),          # pos, decoding
+        s((C,), jnp.float32), s((C,), jnp.int32),        # temp, top_k
+        s((C, 2), jnp.uint32),                           # rngs
+        s((), jnp.int32), s((CH,), jnp.int32),           # pf slot/tokens
+        s((), jnp.int32), s((), jnp.int32),              # pf pos/last_row
+    )
+    closed = jax.make_jaxpr(step)(*args)
+    from ray_lightning_tpu.analysis.tracecheck import _dce
+
+    closed = _dce(closed)
+    import jax as _jax
+
+    params_bytes = sum(
+        int(np.prod(leaf.shape or (1,))) * leaf.dtype.itemsize
+        for leaf in _jax.tree.leaves(a_params))
+    return closed, {"args": args, "params_bytes": params_bytes}
+
+
+def audit_decode_step(model_cfg, engine_cfg: EngineConfig,
+                      topology="v5p-8", reserve_fraction: float = 0.10,
+                      label: str = "serve decode step"):
+    """Full tracecheck walk of the decode step: collective schedule
+    (none expected on a single-replica step — each replica is one model
+    copy), RLT301/303 findings, and the liveness HBM peak vs the chip
+    budget. Returns a `tracecheck.TraceReport`."""
+    from ray_lightning_tpu.analysis.tracecheck import (
+        Finding, TraceReport, _repl, _StepAuditor, _VarInfo,
+        classify_overlap,
+    )
+
+    topo = (topology if isinstance(topology, Topology)
+            else parse_topology(topology))
+    closed, meta = trace_decode_step(model_cfg, engine_cfg)
+    auditor = _StepAuditor({}, topo, {})
+    jaxpr = closed.jaxpr
+    env = {}
+    for v in list(jaxpr.invars) + list(jaxpr.constvars):
+        env[v] = _VarInfo(_repl(len(getattr(v.aval, "shape", ()))),
+                          param=True)
+    peak = auditor.walk(jaxpr, env, 1, False)
+    findings = auditor.findings
+    budget = int(topo.hbm_bytes * (1 - reserve_fraction))
+    if peak > budget:
+        gib = 1024**3
+        findings.append(Finding(
+            "RLT302",
+            f"estimated peak HBM {peak / gib:.2f} GiB/device exceeds "
+            f"the {topo.device_kind} budget {budget / gib:.2f} GiB: the "
+            "serving step will OOM on this chip — shrink capacity, "
+            "blocks_per_slot, or the pool",
+            symbol=label))
+    overlap = classify_overlap(auditor.events, auditor.scopes, topo,
+                               scheduled=auditor.saw_prefetch_marker)
+    return TraceReport(
+        topology=topo,
+        mesh_axes={},
+        collectives=auditor.events,
+        overlap=overlap,
+        findings=findings,
+        params_bytes_per_device=meta["params_bytes"],
+        opt_bytes_per_device=0,
+        peak_hbm_bytes=peak,
+        hbm_budget_bytes=budget,
+        label=label,
+    )
+
+
+def serve_memory_summary(model_cfg, engine_cfg: EngineConfig,
+                         device_kind: str = "TPU v5p",
+                         hbm_bytes: Optional[int] = None) -> dict:
+    """The serve-aware plan leg: itemized replica HBM (no optimizer —
+    serving holds weights, the paged pool, the step's dense gathered
+    view, and the carried logits) with a fits verdict against the chip
+    budget. Pure byte math + one eval_shape; no devices."""
+    import jax
+    import numpy as np
+
+    from ray_lightning_tpu.models.llama import Llama
+    from ray_lightning_tpu.parallel.plan import hbm_bytes_for_kind
+
+    model = Llama(model_cfg)
+    a_params = jax.eval_shape(
+        lambda k: model.init(k, np.zeros((1, 2), np.int32))["params"],
+        jax.eval_shape(lambda: jax.random.key(0)))
+    params_bytes = sum(
+        int(np.prod(leaf.shape or (1,))) * leaf.dtype.itemsize
+        for leaf in jax.tree.leaves(a_params))
+    spec = engine_cfg.pool_spec
+    kv = serve_kv_plan_bytes(model_cfg, spec, engine_cfg.capacity)
+    budget = hbm_bytes if hbm_bytes is not None else \
+        hbm_bytes_for_kind(device_kind)
+    usable = int(budget * 0.90)
+    total = params_bytes + sum(kv.values())
+    return {
+        "params_bytes": int(params_bytes),
+        **kv,
+        "capacity": engine_cfg.capacity,
+        "block_size": spec.block_size,
+        "n_blocks": spec.n_blocks,
+        "max_slot_len": engine_cfg.max_slot_len,
+        "per_device_bytes": int(total),
+        "budget_bytes": usable,
+        "fits": total <= usable,
+    }
+
+
+def format_serve_summary(s: dict) -> str:
+    gib = 1024**3
+    lines = [
+        f"serve plan: {s['capacity']} slots x {s['max_slot_len']} "
+        f"tokens, pool {s['n_blocks']} x {s['block_size']}-token blocks",
+        f"  params           {s['params_bytes'] / gib:7.2f} GiB",
+        f"  kv pool          {s['pool_bytes'] / gib:7.2f} GiB",
+        f"  gathered view    {s['gathered_view_bytes'] / gib:7.2f} GiB"
+        "  (reference engine's dense copy; a fused paged-attention "
+        "kernel retires it)",
+        f"  carried logits   {s['last_logits_bytes'] / gib:7.2f} GiB",
+        f"  total {s['per_device_bytes'] / gib:.2f} GiB vs budget "
+        f"{s['budget_bytes'] / gib:.2f} GiB — "
+        f"{'fits' if s['fits'] else 'DOES NOT FIT'}",
+    ]
+    return "\n".join(lines)

@@ -1,0 +1,309 @@
+"""``python -m ray_lightning_tpu serve`` — the serving front-end + the
+format.sh smoke gate.
+
+    python -m ray_lightning_tpu serve example          # inline demo
+    python -m ray_lightning_tpu serve example --replicas 2 \\
+        --backend process                              # process replicas
+    python -m ray_lightning_tpu serve llama3-8b        # static plan+audit
+    python -m ray_lightning_tpu serve --smoke          # the gate
+
+``--smoke`` (docs/SERVING.md "acceptance") is the CPU gate format.sh
+runs; it fails (exit 1) unless ALL of:
+
+  * 8 concurrent staggered streams (ragged prompts, mixed greedy /
+    temperature / top-k sampling, per-request seeds) decode
+    **bitwise-identical** to 8 independent single-stream `generate()`
+    runs;
+  * request churn across the run compiles the engine step exactly ONCE
+    (compile-count pinned — no silent recompile-per-request);
+  * with 2 process replicas, one injected SIGKILL mid-stream is
+    classified, the replica respawns (weights reloaded, step re-warmed
+    through the persistent compile cache), the lost streams replay
+    bitwise, and the surviving replica's streams are untouched;
+  * the decode step audits clean under tracecheck (no RLT301/RLT303).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+
+def add_serve_parser(sub) -> None:
+    p = sub.add_parser(
+        "serve",
+        help="continuous-batching inference engine: run a demo serve, "
+             "audit the decode step, or the format.sh smoke gate")
+    p.add_argument("preset", nargs="?", default="example",
+                   choices=("example", "llama3-8b"),
+                   help="example = tiny CPU-served demo; llama3-8b = "
+                        "static serve plan + decode-step audit")
+    p.add_argument("--smoke", action="store_true",
+                   help="gate mode (see module docstring); exit 1 on "
+                        "any failed leg")
+    p.add_argument("--replicas", type=int, default=1)
+    p.add_argument("--backend", choices=("inline", "process"),
+                   default="inline")
+    p.add_argument("--requests", type=int, default=8,
+                   help="synthetic demo requests")
+    p.add_argument("--max-new", type=int, default=16)
+    p.add_argument("--slots", type=int, default=4,
+                   help="engine slot capacity per replica")
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--blocks-per-slot", type=int, default=None,
+                   help="default: sized to --seq-budget")
+    p.add_argument("--prefill-chunk", type=int, default=32)
+    p.add_argument("--seq-budget", type=int, default=4096,
+                   help="llama3-8b plan: per-slot prompt+generation cap")
+    p.add_argument("--run-dir", default=None,
+                   help="telemetry spans + serving.json land here")
+    p.add_argument("--topo", default="v5p-8",
+                   help="topology for the decode-step audit")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   default=argparse.SUPPRESS)
+
+
+def _tiny_setup(n_requests: int, max_new: int, seed: int = 1):
+    """Deterministic tiny model + ragged mixed-sampling request set —
+    the same inputs the smoke legs and the demo serve."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_lightning_tpu.models.llama import Llama, LlamaConfig
+    from ray_lightning_tpu.serve.scheduler import Request
+
+    cfg = LlamaConfig.tiny(use_flash=False, dtype=jnp.float32)
+    model = Llama(cfg)
+    prompts = [
+        np.array(jax.random.randint(
+            jax.random.key(100 + i), (1, 3 + (i % 5)), 0,
+            cfg.vocab_size), dtype=np.int32)
+        for i in range(n_requests)
+    ]
+    params = jax.jit(model.init)(jax.random.key(seed), prompts[0])[
+        "params"]
+    reqs = []
+    for i, p in enumerate(prompts):
+        sampled = i % 2 == 1
+        reqs.append(Request(
+            rid=f"r{i}", prompt=p[0], max_new_tokens=max_new,
+            temperature=0.8 if sampled else 0.0,
+            top_k=5 if sampled else None, seed=31 + i))
+    return cfg, model, params, prompts, reqs
+
+
+def _references(model, params, prompts, reqs):
+    """Independent single-stream generate() runs — the bitwise oracle."""
+    import numpy as np
+
+    from ray_lightning_tpu.models.llama import generate
+
+    return {
+        r.rid: np.asarray(generate(
+            model, params, prompts[i], r.max_new_tokens,
+            temperature=r.temperature, top_k=r.top_k, seed=r.seed))[0]
+        for i, r in enumerate(reqs)
+    }
+
+
+def _check_outputs(outputs, refs) -> list:
+    import numpy as np
+
+    bad = []
+    for rid, ref in refs.items():
+        got = np.asarray(outputs.get(rid, []))
+        if not np.array_equal(got, ref):
+            bad.append(rid)
+    return bad
+
+
+def run_smoke(args) -> int:
+    """The format.sh gate. Three legs, all CPU."""
+    from ray_lightning_tpu.serve.audit import audit_decode_step
+    from ray_lightning_tpu.serve.driver import (
+        ReplicaGroupConfig, ServeDriver, save_params_npz,
+    )
+    from ray_lightning_tpu.serve.engine import EngineConfig
+
+    verdict = {"legs": {}}
+    failures = []
+    ecfg = EngineConfig(capacity=4, block_size=4, blocks_per_slot=8,
+                        prefill_chunk=4)
+    cfg, model, params, prompts, reqs = _tiny_setup(8, 8)
+    refs = _references(model, params, prompts, reqs)
+
+    # ---- leg 1: inline churn — 8 staggered streams through 4 slots ----
+    drv = ServeDriver(cfg, params, ReplicaGroupConfig(
+        n_replicas=1, backend="inline", engine=ecfg,
+        reserve="on_demand"))
+    res = drv.run(list(reqs))
+    bad = _check_outputs(res.outputs, refs)
+    compile_ok = res.stats.get("compile_count") in (1, -1)
+    verdict["legs"]["inline_churn"] = {
+        "bitwise_mismatches": bad,
+        "compile_count": res.stats.get("compile_count"),
+        "slot_occupancy": round(res.stats.get("slot_occupancy") or 0, 3),
+    }
+    if bad:
+        failures.append(f"inline streams diverge from generate(): {bad}")
+    if not compile_ok:
+        failures.append(
+            f"request churn recompiled the step: compile_count="
+            f"{res.stats.get('compile_count')} (want 1)")
+
+    # ---- leg 2: process replicas + injected SIGKILL -------------------
+    with tempfile.TemporaryDirectory(prefix="rlt-serve-smoke-") as tmp:
+        pp = os.path.join(tmp, "params.npz")
+        save_params_npz(params, pp)
+        drv2 = ServeDriver(cfg, pp, ReplicaGroupConfig(
+            n_replicas=2, backend="process", engine=ecfg,
+            run_dir=os.path.join(tmp, "run"),
+            compile_cache_dir=os.path.join(tmp, "compile_cache"),
+            env={"JAX_PLATFORMS": "cpu"}))
+        # the driver copies requests before stamping, so the same list
+        # serves both legs without leaking leg 1's arrival times
+        res2 = drv2.run(list(reqs), fault={"replica": 1,
+                                           "kill_after_tokens": 6})
+        bad2 = _check_outputs(res2.outputs, refs)
+        verdict["legs"]["replica_kill"] = {
+            "bitwise_mismatches": bad2,
+            "restarts": res2.restarts,
+            "compile_count": res2.stats.get("compile_count"),
+        }
+        if bad2:
+            failures.append(
+                f"streams diverge after replica kill: {bad2}")
+        if res2.restarts.get(1, 0) < 1:
+            failures.append(
+                "the injected SIGKILL did not produce a replica "
+                "restart — the drill did not run")
+        # surviving replica's requests must have decoded on replica 0
+        # without interruption (no restart there)
+        if res2.restarts.get(0, 0) != 0:
+            failures.append("the SURVIVING replica restarted too")
+
+    # ---- leg 3: decode step audits clean ------------------------------
+    report = audit_decode_step(cfg, ecfg, topology=args.topo)
+    rules = sorted({f.rule for f in report.findings})
+    verdict["legs"]["audit"] = {"findings": rules,
+                                "peak_hbm_bytes": report.peak_hbm_bytes}
+    if any(r in ("RLT301", "RLT303") for r in rules):
+        failures.append(f"decode step audit findings: {rules}")
+
+    verdict["ok"] = not failures
+    if failures:
+        verdict["failures"] = failures
+    print(json.dumps(verdict))
+    if failures:
+        for f in failures:
+            print(f"serve --smoke FAILED: {f}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def _run_example(args) -> int:
+    import contextlib
+
+    from ray_lightning_tpu.serve.driver import (
+        ReplicaGroupConfig, ServeDriver, save_params_npz,
+    )
+    from ray_lightning_tpu.serve.engine import EngineConfig
+
+    bps = args.blocks_per_slot or 8
+    ecfg = EngineConfig(capacity=args.slots, block_size=args.block_size,
+                        blocks_per_slot=bps,
+                        prefill_chunk=args.prefill_chunk)
+    cfg, model, params, prompts, reqs = _tiny_setup(
+        args.requests, args.max_new)
+    with contextlib.ExitStack() as stack:
+        if args.backend == "process":
+            tmp = stack.enter_context(
+                tempfile.TemporaryDirectory(prefix="rlt-serve-"))
+            pp = os.path.join(tmp, "params.npz")
+            save_params_npz(params, pp)
+            params_arg = pp
+            env = {"JAX_PLATFORMS":
+                   os.environ.get("JAX_PLATFORMS", "cpu")}
+        else:
+            params_arg, env = params, None
+        drv = ServeDriver(cfg, params_arg, ReplicaGroupConfig(
+            n_replicas=args.replicas, backend=args.backend, engine=ecfg,
+            run_dir=args.run_dir, env=env))
+        res = drv.run(reqs)
+    ttfts = sorted(m["ttft_s"] for m in res.meta.values())
+    line = {
+        "preset": "example",
+        "n_requests": len(reqs),
+        "decode_tokens_per_s": round(
+            res.stats["decode_tokens_per_s"], 2),
+        "slot_occupancy": res.stats.get("slot_occupancy"),
+        "ttft_p50_s": round(ttfts[len(ttfts) // 2], 4),
+        "ttft_max_s": round(ttfts[-1], 4),
+        "compile_count": res.stats.get("compile_count"),
+        "restarts": res.restarts,
+    }
+    if getattr(args, "as_json", False):
+        print(json.dumps(line))
+    else:
+        print(f"served {line['n_requests']} requests: "
+              f"{line['decode_tokens_per_s']} tok/s decode, "
+              f"occupancy {line['slot_occupancy']:.2f}, "
+              f"TTFT p50 {line['ttft_p50_s']}s")
+        if args.run_dir:
+            print(f"telemetry: {args.run_dir} "
+                  f"(python -m ray_lightning_tpu report {args.run_dir})")
+    return 0
+
+
+def _run_flagship(args) -> int:
+    """llama3-8b: no weights ship with the repo, so this is the STATIC
+    leg — the serve plan + decode-step audit for the flagship config —
+    honest about what it is (a box with weights runs `example`-style
+    serving through the same driver)."""
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.models.llama import LlamaConfig
+    from ray_lightning_tpu.serve.audit import (
+        audit_decode_step, format_serve_summary, serve_memory_summary,
+    )
+    from ray_lightning_tpu.serve.engine import EngineConfig
+
+    cfg = LlamaConfig.llama3_8b(max_seq_len=args.seq_budget,
+                                dtype=jnp.bfloat16)
+    bps = args.blocks_per_slot or -(-args.seq_budget // args.block_size)
+    ecfg = EngineConfig(capacity=args.slots, block_size=args.block_size,
+                        blocks_per_slot=bps,
+                        prefill_chunk=max(args.prefill_chunk, 128))
+    summary = serve_memory_summary(cfg, ecfg)
+    report = audit_decode_step(cfg, ecfg, topology=args.topo,
+                               label="llama3-8b serve")
+    rules = sorted({f.rule for f in report.findings})
+    if getattr(args, "as_json", False):
+        print(json.dumps({
+            "preset": "llama3-8b", "plan": summary,
+            "audit": {"findings": rules,
+                      "peak_hbm_bytes": report.peak_hbm_bytes,
+                      "hbm_budget_bytes": report.hbm_budget_bytes},
+        }))
+    else:
+        print(format_serve_summary(summary))
+        print(f"decode-step audit ({args.topo}): "
+              f"{'clean' if not rules else rules}, liveness peak "
+              f"{report.peak_hbm_bytes / 1024**3:.2f} GiB")
+        print("note: static leg — no weights ship with the repo; with "
+              "a params .npz this config serves through the same "
+              "driver (docs/SERVING.md)")
+    bad = summary["fits"] is False or any(
+        r in ("RLT301", "RLT303") for r in rules)
+    return 1 if bad else 0
+
+
+def run_serve(args) -> int:
+    if args.smoke:
+        return run_smoke(args)
+    if args.preset == "llama3-8b":
+        return _run_flagship(args)
+    return _run_example(args)

@@ -1,0 +1,519 @@
+"""Serving front-end: request streams multiplexed over replica groups.
+
+A **replica** is one model copy behind one `DecodeEngine` + `Scheduler`
+pair. Two backends share one driver surface:
+
+  * ``backend="inline"`` — engines in this process, ticked round-robin
+    (deterministic; what unit tests and single-host serving use);
+  * ``backend="process"`` — each replica is a `runtime.WorkerGroup` of
+    one worker process with its own jax runtime, streaming tokens back
+    over the group's side channel. Replica death is classified by the
+    resilience taxonomy (`resilience.policy.classify_failure`) and,
+    within the restart budget, the driver **respawns** the replica: the
+    worker reloads weights from the params file, re-warms the step
+    through the persistent compile cache (`pipeline.compile_cache` —
+    the restart deserializes instead of recompiling), announces itself
+    live, and REPLAYS the requests the dead replica had not finished.
+    Replay is bitwise-safe by construction — per-request seeds make a
+    decoded stream a pure function of the request — so a kill corrupts
+    nothing: surviving replicas never notice, and the replayed streams
+    are identical to what the dead replica would have produced
+    (test-pinned; the serve --smoke gate injects a real SIGKILL).
+
+Telemetry: each replica owns a `telemetry.TelemetryRecorder` and
+records the serving span vocabulary (queue_wait / prefill / decode /
+detokenize, spans.SERVE_PHASES) per COMPLETED request — cadence-safe —
+plus per-request TTFT/TPOT meta the `report` CLI aggregates into its
+serving section (docs/OBSERVABILITY.md).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ray_lightning_tpu.serve.engine import DecodeEngine, EngineConfig
+from ray_lightning_tpu.serve.scheduler import Completion, Request, Scheduler
+from ray_lightning_tpu.utils import get_logger
+
+log = get_logger(__name__)
+
+#: spans are flushed every this many completions (and at shutdown) —
+#: the serving analog of the trainer's logging cadence
+FLUSH_EVERY_N_COMPLETIONS = 16
+
+
+# ---- params serialization (the replica weight-reload path) ----------------
+
+def save_params_npz(params, path: str) -> None:
+    """Flatten a params pytree to one .npz keyed by `/`-joined paths —
+    the weight file a (re)spawned replica loads. Exact round-trip:
+    numpy arrays at their stored dtypes, no re-quantization."""
+    from ray_lightning_tpu.utils.pytree import named_leaves
+
+    flat = {path_: np.asarray(leaf) for path_, leaf in
+            named_leaves(params)}
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    np.savez(path, **flat)
+
+
+def load_params_npz(path: str):
+    """Rebuild the nested params dict from `save_params_npz` output."""
+    out: Dict[str, Any] = {}
+    with np.load(path) as data:
+        for key in data.files:
+            node = out
+            parts = key.split("/")
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = data[key]
+    return out
+
+
+# ---- configuration --------------------------------------------------------
+
+@dataclasses.dataclass
+class ReplicaGroupConfig:
+    """How the driver runs its replicas."""
+
+    n_replicas: int = 1
+    backend: str = "inline"              # "inline" | "process"
+    engine: EngineConfig = dataclasses.field(default_factory=EngineConfig)
+    reserve: str = "worst_case"
+    #: run dir: telemetry spans + serving.json summary land here
+    run_dir: Optional[str] = None
+    #: persistent compile cache (pipeline.compile_cache) — respawned
+    #: replicas deserialize the step instead of recompiling
+    compile_cache_dir: Optional[str] = None
+    max_restarts: int = 2
+    #: extra env for process replicas (e.g. {"JAX_PLATFORMS": "cpu"})
+    env: Optional[Dict[str, str]] = None
+    start_timeout: float = 180.0
+
+    def __post_init__(self):
+        if self.backend not in ("inline", "process"):
+            raise ValueError(f"backend={self.backend!r}")
+        if self.n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1")
+
+
+@dataclasses.dataclass
+class ServeResult:
+    #: rid -> emitted token ids
+    outputs: Dict[str, List[int]]
+    #: rid -> completion metadata (ttft_s, tpot_s, queue_wait_s, ...)
+    meta: Dict[str, dict]
+    #: replica_id -> restarts performed
+    restarts: Dict[int, int]
+    #: aggregate serving stats (decode_tokens_per_s, slot_occupancy, ...)
+    stats: dict
+
+
+# ---- per-request telemetry -------------------------------------------------
+
+def _record_completion(recorder, comp: Completion, replica: int) -> None:
+    """Emit the request's serving spans from the scheduler's measured
+    host times. Explicit `record()` calls with back-dated starts: the
+    spans were already over when the request completed."""
+    from ray_lightning_tpu.telemetry.spans import (
+        PH_DECODE, PH_PREFILL, PH_QUEUE_WAIT,
+    )
+
+    decode_start = time.perf_counter() - comp.decode_s   # first token
+    prefill_start = decode_start - comp.ttft_s           # admission
+    meta = {"rid": comp.rid, "replica": replica,
+            "tokens": len(comp.tokens), "ttft_s": round(comp.ttft_s, 6),
+            "tpot_s": round(comp.tpot_s, 6),
+            "finish": comp.finish_reason, "preempted": comp.preempted}
+    recorder.record(PH_QUEUE_WAIT, prefill_start - comp.queue_wait_s,
+                    comp.queue_wait_s, meta={"rid": comp.rid})
+    recorder.record(PH_PREFILL, prefill_start, comp.ttft_s,
+                    meta={"rid": comp.rid})
+    recorder.record(PH_DECODE, decode_start, comp.decode_s, meta=meta)
+
+
+def _make_recorder(run_dir: Optional[str], replica: int):
+    from ray_lightning_tpu.telemetry.spans import (
+        NULL_RECORDER, TelemetryRecorder,
+    )
+
+    if run_dir is None:
+        return NULL_RECORDER
+    return TelemetryRecorder(
+        os.path.join(run_dir, "telemetry"), rank=replica)
+
+
+# ---- one replica's serving loop (runs in-process or in the worker) --------
+
+def _serve_loop(engine: DecodeEngine, reserve: str,
+                requests: Sequence[Request], replica: int,
+                run_dir: Optional[str] = None,
+                on_token=None, on_completion=None, on_preempt=None,
+                fault: Optional[dict] = None,
+                fault_dir: Optional[str] = None):
+    """Drain ``requests`` through one replica. ``on_token(rid, tok)``
+    streams tokens as they are emitted; ``on_completion(comp)`` fires at
+    retirement. ``fault={"kill_after_tokens": n}`` SIGKILLs this process
+    after the n-th emitted token, once per ``fault_dir`` marker — the
+    smoke gate's mid-stream replica death."""
+    recorder = _make_recorder(run_dir, replica)
+    sched = Scheduler(engine, reserve=reserve)
+    for req in requests:
+        sched.submit(req)
+    emitted_total = 0
+    kill_after = int((fault or {}).get("kill_after_tokens", 0))
+    marker = (os.path.join(fault_dir, f"replica{replica}.killed")
+              if fault_dir else None)
+    done: List[Completion] = []
+    while sched.busy():
+        completions = sched.tick()
+        for rid in sched.last_preemptions:
+            # the replay regenerates the stream bitwise — a consumer
+            # keeping the pre-preemption prefix would duplicate tokens
+            if on_preempt is not None:
+                on_preempt(rid)
+        for rid, tok in sched.last_emissions:
+            emitted_total += 1
+            if on_token is not None:
+                on_token(rid, tok)
+        for comp in completions:
+            done.append(comp)
+            _record_completion(recorder, comp, replica)
+            if on_completion is not None:
+                on_completion(comp)
+            if len(done) % FLUSH_EVERY_N_COMPLETIONS == 0:
+                recorder.flush()
+        if (kill_after and emitted_total >= kill_after and marker
+                and not os.path.exists(marker)):
+            # fire-once across respawns: the marker outlives this
+            # process, so the replayed replica serves to completion
+            with open(marker, "w") as f:
+                f.write(str(emitted_total))
+            recorder.flush()
+            os.kill(os.getpid(), signal.SIGKILL)
+    recorder.flush()
+    recorder.close()
+    return done, sched
+
+
+# ---- process-replica worker main ------------------------------------------
+
+def _replica_worker_main(model_cfg_kw: dict, params_path: str,
+                         engine_kw: dict, reserve: str,
+                         request_dicts: List[dict], replica: int,
+                         run_dir: Optional[str],
+                         compile_cache_dir: Optional[str],
+                         fault: Optional[dict],
+                         fault_dir: Optional[str]) -> dict:
+    """Runs inside the WorkerGroup worker process: rebuild the model,
+    reload weights, warm the step (persistent compile cache when
+    armed), announce live, then serve — streaming every token over the
+    side channel so the driver holds partial streams when this process
+    dies mid-request."""
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.models.llama import Llama, LlamaConfig
+    from ray_lightning_tpu.runtime import session
+
+    if compile_cache_dir:
+        from ray_lightning_tpu.pipeline.compile_cache import (
+            enable_persistent_cache,
+        )
+
+        enable_persistent_cache(compile_cache_dir)
+    dtype = model_cfg_kw.pop("dtype", "float32")
+    cfg = LlamaConfig(**model_cfg_kw, dtype=jnp.dtype(dtype))
+    model = Llama(cfg)
+    params = load_params_npz(params_path)
+    t0 = time.perf_counter()
+    engine = DecodeEngine(model, params, EngineConfig(**engine_kw))
+    engine.warmup()
+    warm_s = time.perf_counter() - t0
+    session.put_queue(("live", replica, {"warmup_s": round(warm_s, 3)}))
+    requests = [Request(**d) for d in request_dicts]
+
+    def on_token(rid, tok):
+        session.put_queue(("tok", replica, rid, tok))
+
+    def on_preempt(rid):
+        session.put_queue(("preempt", replica, rid))
+
+    def on_completion(comp):
+        session.put_queue(("done", replica, comp.rid, {
+            "finish_reason": comp.finish_reason,
+            "queue_wait_s": comp.queue_wait_s,
+            "ttft_s": comp.ttft_s, "tpot_s": comp.tpot_s,
+            "decode_s": comp.decode_s, "preempted": comp.preempted,
+            "n_tokens": len(comp.tokens),
+        }))
+
+    done, sched = _serve_loop(engine, reserve, requests, replica,
+                              run_dir=run_dir, on_token=on_token,
+                              on_completion=on_completion,
+                              on_preempt=on_preempt, fault=fault,
+                              fault_dir=fault_dir)
+    return {"replica": replica, "completed": len(done),
+            "steps": engine.steps, "warmup_s": warm_s,
+            "compile_count": engine.compile_count,
+            "occupancy": sched.slot_occupancy}
+
+
+# ---- the driver ------------------------------------------------------------
+
+class ServeDriver:
+    """Multiplex request streams over ``cfg.n_replicas`` replicas.
+
+    ``model_cfg`` is a `models.llama.LlamaConfig`; ``params`` is the
+    weights pytree (inline) or a ``.npz`` path from `save_params_npz`
+    (required for process replicas — the weight-reload path IS the
+    respawn story). Requests are assigned round-robin at submission;
+    on replica death the unfinished remainder replays on the respawned
+    replica.
+    """
+
+    def __init__(self, model_cfg, params, cfg: ReplicaGroupConfig):
+        self.model_cfg = model_cfg
+        self.cfg = cfg
+        self.params = params
+        self.params_path = params if isinstance(params, str) else None
+        if cfg.backend == "process" and self.params_path is None:
+            raise ValueError(
+                "process replicas need a params .npz path "
+                "(save_params_npz) — the respawn path reloads from it")
+
+    # ---- inline ----------------------------------------------------------
+
+    def _run_inline(self, requests: Sequence[Request],
+                    fault: Optional[dict]) -> ServeResult:
+        from ray_lightning_tpu.models.llama import Llama
+
+        params = self.params
+        if self.params_path is not None:
+            params = load_params_npz(self.params_path)
+        model = Llama(self.model_cfg)
+        outputs: Dict[str, List[int]] = {}
+        meta: Dict[str, dict] = {}
+        stats_occ: List[float] = []
+        t0 = time.perf_counter()
+        n_tokens = 0
+        scheds = []
+        for r in range(self.cfg.n_replicas):
+            engine = DecodeEngine(model, params, self.cfg.engine)
+            engine.warmup()
+            sched = Scheduler(engine, reserve=self.cfg.reserve)
+            scheds.append(sched)
+        recorders = [_make_recorder(self.cfg.run_dir, r)
+                     for r in range(self.cfg.n_replicas)]
+        for i, req in enumerate(requests):
+            scheds[i % len(scheds)].submit(req)
+            outputs[req.rid] = []
+        # round-robin tick until every replica drains — the inline
+        # analog of replicas running concurrently
+        while any(s.busy() for s in scheds):
+            for r, sched in enumerate(scheds):
+                if not sched.busy():
+                    continue
+                completions = sched.tick()
+                for rid in sched.last_preemptions:
+                    outputs[rid] = []  # the replay resends from scratch
+                for rid, tok in sched.last_emissions:
+                    outputs[rid].append(tok)
+                    n_tokens += 1
+                for comp in completions:
+                    _record_completion(recorders[r], comp, r)
+                    meta[comp.rid] = {
+                        "replica": r,
+                        "finish_reason": comp.finish_reason,
+                        "queue_wait_s": comp.queue_wait_s,
+                        "ttft_s": comp.ttft_s, "tpot_s": comp.tpot_s,
+                        "preempted": comp.preempted,
+                        "n_tokens": len(comp.tokens),
+                    }
+        wall = time.perf_counter() - t0
+        for r, sched in enumerate(scheds):
+            stats_occ.append(sched.slot_occupancy)
+            recorders[r].flush()
+            recorders[r].close()
+        stats = {
+            "decode_tokens_per_s": n_tokens / max(wall, 1e-9),
+            "slot_occupancy": float(np.mean(stats_occ)),
+            "n_requests": len(requests), "n_tokens": n_tokens,
+            "wall_s": wall,
+            "compile_count": max(s.engine.compile_count for s in scheds),
+        }
+        result = ServeResult(outputs=outputs, meta=meta,
+                             restarts={r: 0 for r in
+                                       range(self.cfg.n_replicas)},
+                             stats=stats)
+        self._write_summary(result)
+        return result
+
+    # ---- process replicas ------------------------------------------------
+
+    def _run_process(self, requests: Sequence[Request],
+                     fault: Optional[dict]) -> ServeResult:
+        import threading
+
+        from ray_lightning_tpu.resilience.policy import classify_failure
+        from ray_lightning_tpu.runtime.group import WorkerGroup
+
+        cfgkw = dataclasses.asdict(self.model_cfg)
+        cfgkw["dtype"] = np.dtype(self.model_cfg.dtype).name
+        enginekw = dataclasses.asdict(self.cfg.engine)
+        n = self.cfg.n_replicas
+        assign: List[List[Request]] = [[] for _ in range(n)]
+        outputs: Dict[str, List[int]] = {}
+        meta: Dict[str, dict] = {}
+        for i, req in enumerate(requests):
+            assign[i % n].append(req)
+            outputs[req.rid] = []
+        restarts = {r: 0 for r in range(n)}
+        errors: List[BaseException] = []
+        lock = threading.Lock()
+        fault_dir = self.cfg.run_dir or os.path.join(
+            os.getcwd(), "rlt_logs", "serve")
+        os.makedirs(fault_dir, exist_ok=True)
+        t0 = time.perf_counter()
+        token_count = [0]
+        warmups: Dict[int, List[float]] = {r: [] for r in range(n)}
+        occupancy: Dict[int, float] = {}
+        compile_counts: Dict[int, int] = {}
+
+        def on_queue_item(_rank, item):
+            kind = item[0]
+            with lock:
+                if kind == "tok":
+                    _, _rep, rid, tok = item
+                    outputs[rid].append(tok)
+                    token_count[0] += 1
+                elif kind == "preempt":
+                    # scheduler-level preemption: the replay resends
+                    # the stream from scratch — drop the prefix
+                    outputs[item[2]] = []
+                elif kind == "done":
+                    _, rep, rid, m = item
+                    meta[rid] = {"replica": rep, **m}
+                elif kind == "live":
+                    warmups[item[1]].append(item[2]["warmup_s"])
+
+        def run_replica(r: int) -> None:
+            remaining = list(assign[r])
+            rep_fault = (fault if fault and
+                         fault.get("replica", 0) == r else None)
+            while True:
+                with lock:
+                    remaining = [q for q in remaining
+                                 if q.rid not in meta]
+                    for q in remaining:
+                        # drop partial streams of requests the dead
+                        # replica had in flight — replay regenerates
+                        # them bitwise from the seed
+                        outputs[q.rid] = []
+                if not remaining:
+                    return
+                group = WorkerGroup(
+                    num_workers=1, env=dict(self.cfg.env or {}),
+                    log_dir=os.path.join(fault_dir, f"replica{r}"),
+                    start_timeout=self.cfg.start_timeout)
+                try:
+                    group.start()
+                    res = group.run(
+                        _replica_worker_main,
+                        shared_args=(
+                            dict(cfgkw), self.params_path,
+                            dict(enginekw), self.cfg.reserve,
+                            [_req_dict(q) for q in remaining], r,
+                            self.cfg.run_dir,
+                            self.cfg.compile_cache_dir, rep_fault,
+                            fault_dir),
+                        on_queue_item=on_queue_item)
+                    with lock:
+                        occupancy[r] = res[0]["occupancy"]
+                        compile_counts[r] = res[0]["compile_count"]
+                    return
+                except Exception as exc:  # noqa: BLE001 — classified below
+                    fc = classify_failure(exc)
+                    log.warning(
+                        "serve replica %d died (%s/%s): %s", r, fc.kind,
+                        fc.cause, fc.detail)
+                    if (not fc.restartable
+                            or restarts[r] >= self.cfg.max_restarts):
+                        with lock:
+                            errors.append(exc)
+                        return
+                    restarts[r] += 1
+                finally:
+                    group.shutdown()
+
+        threads = [threading.Thread(target=run_replica, args=(r,),
+                                    daemon=True) for r in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        wall = time.perf_counter() - t0
+        warm_all = [w for ws in warmups.values() for w in ws]
+        stats = {
+            "decode_tokens_per_s": token_count[0] / max(wall, 1e-9),
+            "slot_occupancy": (float(np.mean(list(occupancy.values())))
+                               if occupancy else None),
+            "n_requests": len(requests), "n_tokens": token_count[0],
+            "wall_s": wall,
+            "warmup_cold_s": warm_all[0] if warm_all else None,
+            "warmup_respawn_s": (max(warm_all[1:]) if len(warm_all) > 1
+                                 else None),
+            "compile_count": (max(compile_counts.values())
+                              if compile_counts else None),
+            "restarts_total": sum(restarts.values()),
+        }
+        result = ServeResult(outputs=outputs, meta=meta,
+                             restarts=restarts, stats=stats)
+        self._write_summary(result)
+        return result
+
+    # ---- entry -----------------------------------------------------------
+
+    def run(self, requests: Sequence[Request],
+            fault: Optional[dict] = None) -> ServeResult:
+        """Serve ``requests`` to completion. ``fault`` (process backend
+        only): ``{"replica": r, "kill_after_tokens": n}`` SIGKILLs
+        replica ``r`` once, mid-stream — the recovery drill."""
+        # COPY before stamping: mutating the caller's Request objects
+        # would make a reused request list carry the previous run's
+        # arrival stamps, silently inflating every queue_wait/TTFT of
+        # the next run (review finding, test-pinned)
+        requests = [dataclasses.replace(r) for r in requests]
+        now = time.perf_counter()
+        for req in requests:
+            if req.arrival == 0.0:
+                req.arrival = now
+        if self.cfg.backend == "inline":
+            if fault:
+                raise ValueError("fault injection needs "
+                                 "backend='process' — a replica must "
+                                 "die for real to drill recovery")
+            return self._run_inline(requests, fault)
+        return self._run_process(requests, fault)
+
+    def _write_summary(self, result: ServeResult) -> None:
+        if self.cfg.run_dir is None:
+            return
+        os.makedirs(self.cfg.run_dir, exist_ok=True)
+        path = os.path.join(self.cfg.run_dir, "serving.json")
+        with open(path, "w") as f:
+            json.dump({"stats": result.stats, "meta": result.meta,
+                       "restarts": result.restarts}, f, indent=2)
+
+
+def _req_dict(req: Request) -> dict:
+    return {"rid": req.rid, "prompt": np.asarray(req.prompt).tolist(),
+            "max_new_tokens": req.max_new_tokens,
+            "temperature": req.temperature, "top_k": req.top_k,
+            "seed": req.seed, "eos_id": req.eos_id}

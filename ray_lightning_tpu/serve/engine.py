@@ -1,0 +1,319 @@
+"""The continuous-batching decode engine: ONE jitted step for a serving
+replica's whole lifetime.
+
+Shape discipline is the design (docs/SERVING.md): the step is compiled
+once for a fixed slot ``capacity``, pool geometry, and prefill chunk
+width; admission, retirement, and per-request sampling knobs arrive as
+*runtime* int/float arrays, so request churn can never retrace — the
+engine pins its own compile count (`compile_count`) and the smoke gate
+asserts it stays 1 across a full churned workload.
+
+One step does two things, both masked, both fixed-shape:
+
+  * **decode lane** — for every slot: split its RNG, sample the next
+    token from the slot's carried ``last_logits`` (greedy /
+    temperature / top-k chosen by *runtime* per-slot values), run the
+    model's single-token cache path on the sampled token over the
+    slot's gathered paged view, and scatter the new K/V into the pool
+    at ``pos``. Slots not in the decode phase are redirected to the
+    scratch block and their state is `where`-masked through unchanged.
+  * **prefill lane** — at most one slot advances its prompt by one
+    fixed-width chunk through the model's chunked cache path
+    (``lax.cond``-gated: a step with no admission pays no prefill
+    compute). The final chunk also projects the last real prompt row
+    through the lm_head into ``last_logits`` — the logits the decode
+    lane will sample the first generated token from, exactly where
+    single-stream `generate`'s prefill leaves it.
+
+Numerics: every lane reuses the model's OWN cache path (`Llama.apply`
+vmapped per slot), the sampling math mirrors `generate`'s per step
+(same split sequence, same categorical call shape), and every padded /
+scratch position is masked to exact-zero influence before softmax —
+per-request token streams are **bitwise-identical** to independent
+single-stream `generate` runs on the XLA reference path (test-pinned;
+the smoke gate re-proves it on every format.sh run).
+
+HBM: the pool (plus one dense gathered view per step) is donated
+through the step along with ``last_logits``, so steady-state serving
+holds one pool, not two (`serve/audit.py` prices all of it in the
+``plan --serve`` leg).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_lightning_tpu.serve.kv_cache import PagedPoolSpec, init_pool
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Static shape of one serving replica's compiled step."""
+
+    #: concurrent request slots (the decode lane's fixed batch)
+    capacity: int = 8
+    #: tokens per pool block
+    block_size: int = 16
+    #: per-slot block-table width — caps prompt + generation length at
+    #: ``blocks_per_slot * block_size``
+    blocks_per_slot: int = 8
+    #: pool blocks (None = dense worst case: capacity * blocks_per_slot
+    #: + scratch). Smaller oversubscribes — the paged bet.
+    n_blocks: Optional[int] = None
+    #: prefill chunk width: one admitting slot advances this many prompt
+    #: tokens per step (TTFT = ceil(prompt / chunk) steps + one sample)
+    prefill_chunk: int = 32
+
+    def __post_init__(self):
+        if self.capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        if self.prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+        if self.prefill_chunk > self.blocks_per_slot * self.block_size:
+            # the scheduler slides the chunk window back to keep the
+            # full width inside the slot; a chunk wider than the slot
+            # itself has no valid window at all
+            raise ValueError(
+                f"prefill_chunk {self.prefill_chunk} exceeds "
+                f"max_slot_len "
+                f"{self.blocks_per_slot * self.block_size}")
+
+    @property
+    def pool_spec(self) -> PagedPoolSpec:
+        n = self.n_blocks
+        if n is None:
+            n = 1 + self.capacity * self.blocks_per_slot
+        return PagedPoolSpec(n_blocks=n, block_size=self.block_size,
+                             blocks_per_slot=self.blocks_per_slot)
+
+    @property
+    def max_slot_len(self) -> int:
+        return self.pool_spec.gathered_len
+
+
+def _sample_one(logits, key, temp, top_k):
+    """Per-slot sampling, runtime-switched, mirroring `generate`'s
+    static-python `sample` bit for bit per mode:
+
+      * temp == 0      -> argmax (the categorical draw is computed and
+                          discarded — fixed shapes beat a branch)
+      * top_k > 0      -> k-th-largest threshold filter; the threshold
+                          VALUE from a descending sort equals
+                          ``lax.top_k(x, k)[0][:, -1]`` for runtime k
+      * else           -> plain temperature sampling
+
+    The categorical call takes ``[1, V]`` exactly like `generate`'s
+    B=1 call so the drawn bits match under vmap."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temp, jnp.finfo(logits.dtype).tiny)
+    srt = jnp.sort(scaled)[::-1]
+    kth = srt[jnp.clip(top_k, 1, scaled.shape[0]) - 1]
+    filtered = jnp.where(scaled >= kth, scaled, -jnp.inf)
+    sampled_from = jnp.where(top_k > 0, filtered, scaled)
+    drawn = jax.random.categorical(
+        key, sampled_from[None, :])[0].astype(jnp.int32)
+    return jnp.where(temp == 0.0, greedy, drawn)
+
+
+def build_step(model, cfg: EngineConfig):
+    """The jitted continuous-batching step for ``model`` (a
+    `models.llama.Llama` instance) under ``cfg``. Returned uncompiled —
+    `DecodeEngine` jits it with the pool/logits donated; `serve.audit`
+    traces it abstractly."""
+    mcfg = model.cfg
+    spec = cfg.pool_spec
+    L, HKV, HD = mcfg.n_layers, mcfg.n_kv_heads, mcfg.head_dim
+    C, P, G, CH = cfg.capacity, spec.block_size, spec.gathered_len, \
+        cfg.prefill_chunk
+
+    def _decode_one(params, tok, kc, vc, pos):
+        # the model's OWN single-token cache path ([1, 1] batch), new
+        # K/V extracted at the write position for the pool scatter
+        logits, (nk, nv) = model.apply(
+            {"params": params}, tok[None, None],
+            cache=(kc[:, None], vc[:, None]), pos=pos)
+        k_tok = jax.lax.dynamic_slice_in_dim(nk[:, 0], pos, 1,
+                                             axis=1)[:, 0]
+        v_tok = jax.lax.dynamic_slice_in_dim(nv[:, 0], pos, 1,
+                                             axis=1)[:, 0]
+        return logits[0, 0], k_tok, v_tok
+
+    def step(params, pool_k, pool_v, last_logits, tables, pos, decoding,
+             temp, top_k, rngs, prefill_slot, prefill_tokens,
+             prefill_pos, prefill_last_row):
+        """One engine tick. Donated: pool_k, pool_v, last_logits
+        (positions 1-3 of the signature; `DecodeEngine` owns them).
+
+        Host-owned runtime inputs (plain numpy per call):
+          tables   [C, M] i32   slot -> pool block ids (0 = scratch)
+          pos      [C]    i32   tokens written to each slot's cache
+          decoding [C]    bool  slot is in the decode phase
+          temp     [C]    f32 / top_k [C] i32 / rngs [C, 2] u32
+          prefill_slot  i32     slot taking this step's chunk (-1 none)
+          prefill_tokens [CH] i32 / prefill_pos i32
+          prefill_last_row i32  row of the last REAL prompt token
+                                within this chunk (-1: prompt continues)
+
+        Returns (pool_k, pool_v, last_logits, rngs', emitted [C] i32).
+        ``emitted[s]`` is meaningful only where ``decoding[s]`` — the
+        scheduler masks by its own phase bookkeeping.
+        """
+        # ---- decode lane: sample, then advance every slot ------------
+        keys = jax.random.wrap_key_data(rngs)
+        split = jax.vmap(jax.random.split)(keys)
+        nxt, sub = split[:, 0], split[:, 1]
+        # RNG advances exactly once per EMITTED token (generate's body
+        # splits once per loop trip) — idle/prefilling slots hold still
+        new_rngs = jnp.where(decoding[:, None],
+                             jax.random.key_data(nxt), rngs)
+        emitted = jax.vmap(_sample_one)(last_logits, sub, temp, top_k)
+        gk = pool_k[:, tables].reshape(L, C, G, HKV, HD)
+        gv = pool_v[:, tables].reshape(L, C, G, HKV, HD)
+        logits2, k_tok, v_tok = jax.vmap(
+            _decode_one, in_axes=(None, 0, 1, 1, 0), out_axes=(0, 1, 1),
+        )(params, emitted, gk, gv, pos)
+        bi = jnp.where(
+            decoding,
+            jnp.take_along_axis(tables, (pos // P)[:, None],
+                                axis=1)[:, 0],
+            0)
+        off = jnp.where(decoding, pos % P, 0)
+        pool_k = pool_k.at[:, bi, off].set(k_tok)
+        pool_v = pool_v.at[:, bi, off].set(v_tok)
+        last_logits = jnp.where(decoding[:, None], logits2, last_logits)
+
+        # ---- prefill lane: one chunk for one admitting slot ----------
+        def do_prefill(pool_k, pool_v, last_logits):
+            slot = jnp.maximum(prefill_slot, 0)
+            row = tables[slot]
+            kc = pool_k[:, row].reshape(L, 1, G, HKV, HD)
+            vc = pool_v[:, row].reshape(L, 1, G, HKV, HD)
+            logits, (nk, nv) = model.apply(
+                {"params": params}, prefill_tokens[None],
+                cache=(kc, vc), pos=prefill_pos)
+            kw = jax.lax.dynamic_slice_in_dim(nk[:, 0], prefill_pos,
+                                              CH, axis=1)
+            vw = jax.lax.dynamic_slice_in_dim(nv[:, 0], prefill_pos,
+                                              CH, axis=1)
+            # the full CH-wide write is safe past a partial tail chunk:
+            # positions >= prompt_len hold garbage the decode lane
+            # overwrites before any mask ever exposes them
+            wpos = prefill_pos + jnp.arange(CH)
+            wbi = row[wpos // P]
+            pool_k = pool_k.at[:, wbi, wpos % P].set(kw)
+            pool_v = pool_v.at[:, wbi, wpos % P].set(vw)
+            done_row = logits[0, prefill_last_row]
+            finished = prefill_last_row >= 0
+            last_logits = jnp.where(
+                (jnp.arange(C) == slot)[:, None] & finished,
+                done_row[None, :], last_logits)
+            return pool_k, pool_v, last_logits
+
+        pool_k, pool_v, last_logits = jax.lax.cond(
+            prefill_slot >= 0, do_prefill,
+            lambda a, b, c: (a, b, c), pool_k, pool_v, last_logits)
+        return pool_k, pool_v, last_logits, new_rngs, emitted
+
+    return step
+
+
+#: the step's no-prefill sentinel tuple: (slot, tokens, pos, last_row)
+def idle_prefill(cfg: EngineConfig):
+    return (np.int32(-1), np.zeros(cfg.prefill_chunk, np.int32),
+            np.int32(0), np.int32(-1))
+
+
+class DecodeEngine:
+    """One replica's compiled step + its device-resident buffers.
+
+    Owns ``pool_k/pool_v/last_logits`` (donated through every step —
+    callers must never hold references to them) and the compile-count
+    pin. The host-side request state lives in `serve.scheduler`.
+    """
+
+    def __init__(self, model, params, cfg: EngineConfig,
+                 max_seq_len_check: bool = True):
+        if max_seq_len_check and cfg.max_slot_len > model.cfg.max_seq_len:
+            raise ValueError(
+                f"engine max_slot_len {cfg.max_slot_len} exceeds the "
+                f"model's max_seq_len {model.cfg.max_seq_len} — RoPE "
+                "tables would be read out of range")
+        self.model = model
+        # canonicalize the weights' placement: trainer-produced params
+        # arrive committed to a NamedSharding over the training mesh,
+        # and a step closed over those emits NamedSharding outputs —
+        # so the donated pool buffers (built SingleDeviceSharding by
+        # init_pool) change signature after the first tick and the step
+        # compiles a SECOND executable (observed in the
+        # fine-tune -> serve flow; test-pinned). Committing the weights
+        # to one concrete device keeps every signature
+        # SingleDeviceSharding from the first tick on — one replica is
+        # one model copy today (sharded replicas are the roadmap's
+        # elastic-scale follow-up, docs/SERVING.md).
+        self.params = jax.device_put(params, jax.devices()[0])
+        self.cfg = cfg
+        self.spec = cfg.pool_spec
+        self._step = jax.jit(build_step(model, cfg),
+                             donate_argnums=(1, 2, 3))
+        # COMMIT the device-resident buffers to the same device as the
+        # weights: a fresh jnp.zeros is uncommitted, but the step's
+        # outputs are committed, so an uncommitted first-tick signature
+        # would compile a second executable the moment the donated
+        # outputs cycle back in (same phantom-recompile class as the
+        # params placement above; the churn pin covers both)
+        device = jax.devices()[0]
+        pool_k, pool_v = init_pool(model.cfg, self.spec)
+        self.pool_k = jax.device_put(pool_k, device)
+        self.pool_v = jax.device_put(pool_v, device)
+        self.last_logits = jax.device_put(
+            jnp.zeros((cfg.capacity, model.cfg.vocab_size), jnp.float32),
+            device)
+        self.steps = 0
+
+    # ---- compile accounting ---------------------------------------------
+
+    @property
+    def compile_count(self) -> int:
+        """Distinct compiled programs behind the step — the churn gate
+        pins this at 1. Falls back to -1 (unknown) on a jax without the
+        cache-size introspection rather than failing serving."""
+        try:
+            return int(self._step._cache_size())
+        except Exception:  # noqa: BLE001 — introspection is advisory
+            return -1
+
+    def warmup(self) -> None:
+        """Compile (or deserialize, when a persistent compile cache is
+        armed — `pipeline.compile_cache`) the step before the replica
+        is marked live: an idle tick on the zero pool. P99 TTFT is a
+        compile-cache metric (ROADMAP item 1)."""
+        C = self.cfg.capacity
+        self.tick(
+            tables=np.zeros((C, self.spec.blocks_per_slot), np.int32),
+            pos=np.zeros(C, np.int32),
+            decoding=np.zeros(C, bool),
+            temp=np.zeros(C, np.float32),
+            top_k=np.zeros(C, np.int32),
+            rngs=np.zeros((C, 2), np.uint32),
+            prefill=idle_prefill(self.cfg),
+        )
+
+    # ---- the tick --------------------------------------------------------
+
+    def tick(self, tables, pos, decoding, temp, top_k, rngs, prefill):
+        """Run one step; returns (emitted [C] i32 np, rngs' [C, 2] u32
+        np). The donated device buffers are swapped internally."""
+        pslot, ptoks, ppos, plast = prefill
+        (self.pool_k, self.pool_v, self.last_logits, new_rngs,
+         emitted) = self._step(
+            self.params, self.pool_k, self.pool_v, self.last_logits,
+            jnp.asarray(tables), jnp.asarray(pos), jnp.asarray(decoding),
+            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(rngs),
+            jnp.asarray(pslot), jnp.asarray(ptoks), jnp.asarray(ppos),
+            jnp.asarray(plast))
+        self.steps += 1
+        return np.array(emitted), np.array(new_rngs)

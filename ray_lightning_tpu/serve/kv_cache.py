@@ -1,0 +1,156 @@
+"""Block-paged KV cache: a shared block pool + per-slot block tables.
+
+The training-side cache (`models.llama.init_cache`) is dense: one
+``[L, B, S_max, Hkv, hd]`` buffer per request batch, sized for the
+worst case. Serving cannot afford that shape — requests are ragged,
+arrive and retire continuously, and the cache is the dominant HBM
+consumer — so the serving engine stores KV in fixed-size **blocks**
+drawn from one shared pool:
+
+    pool_k, pool_v : [L, n_blocks, block_size, Hkv, hd]
+    block_table    : [capacity, blocks_per_slot] int32  (host-owned)
+
+A slot's logical cache position ``p`` lives at pool block
+``table[slot, p // block_size]``, offset ``p % block_size``. The device
+step receives the table as a plain int32 input each call: admission and
+retirement only rewrite table rows and host-side scalars, so the
+compiled step never changes shape (the no-recompile-under-churn
+guarantee the engine pins).
+
+Block 0 is the **scratch block**: never allocated, and every index the
+step must not really write (idle slots, the prefill lane when nothing
+is prefilling) is redirected to it. Scratch contents are garbage by
+design; every read of the gathered view is masked by position
+(``kv_pos <= q_pos``) before it can influence attention, and masked
+scores contribute *exactly* zero through the softmax — the bitwise
+parity with single-stream `generate` rests on this (docs/SERVING.md
+"numerics").
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedPoolSpec:
+    """Shape of the paged pool for one model config.
+
+    ``gathered_len = blocks_per_slot * block_size`` is the dense view
+    the step materializes per slot — the per-slot maximum of
+    ``prompt_len + max_new_tokens`` the scheduler can admit.
+    """
+
+    n_blocks: int
+    block_size: int
+    blocks_per_slot: int
+
+    def __post_init__(self):
+        if self.block_size < 1 or self.blocks_per_slot < 1:
+            raise ValueError("block_size and blocks_per_slot must be >= 1")
+        if self.n_blocks < 2:
+            # block 0 is reserved scratch — a pool of 1 block can hold
+            # no request at all
+            raise ValueError("n_blocks must be >= 2 (block 0 is scratch)")
+
+    @property
+    def gathered_len(self) -> int:
+        return self.blocks_per_slot * self.block_size
+
+    @classmethod
+    def for_capacity(cls, capacity: int, max_len: int,
+                     block_size: int = 16,
+                     oversubscribe: float = 1.0) -> "PagedPoolSpec":
+        """A spec sized so ``capacity`` slots of up to ``max_len`` tokens
+        fit. ``oversubscribe < 1`` shrinks the pool below the dense
+        worst case — the paged bet that real lengths are ragged; the
+        scheduler's on-demand mode defers admissions (or preempts) when
+        the bet loses."""
+        bps = -(-max_len // block_size)
+        blocks = max(2, 1 + int(round(capacity * bps * oversubscribe)))
+        return cls(n_blocks=blocks, block_size=block_size,
+                   blocks_per_slot=bps)
+
+
+def init_pool(cfg, spec: PagedPoolSpec):
+    """Zeroed (pool_k, pool_v), leaves
+    ``[n_layers, n_blocks, block_size, n_kv_heads, head_dim]`` in the
+    model's activation dtype — the same per-position layout as
+    `models.llama.init_cache`, block-chunked over the sequence axis."""
+    shape = (cfg.n_layers, spec.n_blocks, spec.block_size,
+             cfg.n_kv_heads, cfg.head_dim)
+    return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
+
+
+def pool_bytes(cfg, spec: PagedPoolSpec) -> int:
+    """HBM held by the pool itself (k + v)."""
+    per = (cfg.n_layers * spec.n_blocks * spec.block_size
+           * cfg.n_kv_heads * cfg.head_dim)
+    return 2 * per * jnp.dtype(cfg.dtype).itemsize
+
+
+def gathered_view_bytes(cfg, spec: PagedPoolSpec, capacity: int) -> int:
+    """HBM of the dense per-slot gathered view the step materializes
+    (k + v): ``[L, capacity, gathered_len, Hkv, hd]``. The reference
+    engine pays this copy for correctness-first paged semantics; a
+    production paged-attention kernel fuses gather+attend and drops it
+    (docs/SERVING.md "cost model") — until then the planner charges it."""
+    per = (cfg.n_layers * capacity * spec.gathered_len
+           * cfg.n_kv_heads * cfg.head_dim)
+    return 2 * per * jnp.dtype(cfg.dtype).itemsize
+
+
+def serve_kv_plan_bytes(cfg, spec: PagedPoolSpec, capacity: int) -> dict:
+    """The serving cache's HBM story for the ``plan --serve`` leg:
+    itemized pool + gathered view + the per-slot logits buffer the
+    engine keeps device-resident between steps."""
+    logits = capacity * cfg.vocab_size * 4  # f32 last_logits
+    return {
+        "pool_bytes": int(pool_bytes(cfg, spec)),
+        "gathered_view_bytes": int(gathered_view_bytes(cfg, spec,
+                                                       capacity)),
+        "last_logits_bytes": int(logits),
+    }
+
+
+class BlockAllocator:
+    """Host-side free-list over the pool's blocks. Block 0 (scratch) is
+    never handed out. Pure bookkeeping — the device never sees this
+    object, only the int32 tables the scheduler builds from it."""
+
+    def __init__(self, spec: PagedPoolSpec):
+        self.spec = spec
+        self._free: List[int] = list(range(1, spec.n_blocks))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` block ids, or None when the pool cannot satisfy the
+        request (the caller defers admission / preempts — never a
+        partial grant, which would strand blocks on a failed admit)."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        ids, self._free = self._free[:n], self._free[n:]
+        return ids
+
+    def free(self, ids) -> None:
+        for b in ids:
+            b = int(b)
+            if b <= 0 or b >= self.spec.n_blocks:
+                raise ValueError(f"freeing invalid block {b}")
+            if b in self._free:
+                raise ValueError(f"double free of block {b}")
+            self._free.append(b)
+
+
+def new_block_table(spec: PagedPoolSpec, capacity: int) -> np.ndarray:
+    """All-scratch table: every entry points at block 0 until the
+    scheduler assigns real blocks on admission."""
+    return np.zeros((capacity, spec.blocks_per_slot), np.int32)

@@ -1,0 +1,356 @@
+"""Host-side slot lifecycle: admission, prefill interleaving, block
+reservation/growth, retirement, preemption.
+
+The scheduler owns every mutable serving decision and keeps it in plain
+numpy — the compiled step only ever sees fixed-shape arrays built here.
+One `tick()` = admit what fits, pick the next prefill chunk, run the
+engine once, account emissions. Determinism: given the same request
+stream (ids, seeds, arrival order) the schedule — and therefore every
+emitted token — is a pure function of the inputs, which is what lets a
+respawned replica REPLAY lost requests to bitwise-identical streams
+(driver.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ray_lightning_tpu.serve.engine import DecodeEngine, idle_prefill
+from ray_lightning_tpu.serve.kv_cache import BlockAllocator, new_block_table
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request. ``seed`` drives the slot's private RNG —
+    sampling is per-request reproducible and batch-order invariant
+    (test-pinned), and `generate(prompt, max_new_tokens, temperature,
+    top_k, seed)` with the same values is the bitwise reference."""
+
+    rid: str
+    prompt: np.ndarray              # [l] int32 token ids
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    seed: int = 0
+    eos_id: Optional[int] = None
+    #: host wall time the request entered the queue (queue_wait span)
+    arrival: float = 0.0
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+        if self.prompt.size < 1:
+            raise ValueError(f"request {self.rid}: empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError(f"request {self.rid}: max_new_tokens < 1")
+
+
+@dataclasses.dataclass
+class Completion:
+    rid: str
+    tokens: List[int]
+    finish_reason: str              # "eos" | "length"
+    queue_wait_s: float
+    ttft_s: float                   # admission -> first token (host wall)
+    decode_s: float                 # first token -> completion
+    preempted: int = 0              # times this request was re-queued
+
+    @property
+    def tpot_s(self) -> float:
+        """Mean time per output token after the first."""
+        n = max(1, len(self.tokens) - 1)
+        return self.decode_s / n
+
+
+class _Slot:
+    __slots__ = ("req", "blocks", "emitted", "prefill_next",
+                 "admitted_at", "first_token_at", "preempted", "seq")
+
+    def __init__(self, req: Request, blocks: List[int], preempted: int,
+                 seq: int):
+        self.req = req
+        self.blocks = blocks            # allocated pool block ids
+        self.emitted: List[int] = []
+        self.prefill_next = 0           # prompt tokens already chunked
+        self.admitted_at = time.perf_counter()
+        self.first_token_at: Optional[float] = None
+        self.preempted = preempted
+        #: admission order — the preemption policy's age (monotonic,
+        #: tie-free where wall clocks are not)
+        self.seq = seq
+
+
+def _key_data(seed: int) -> np.ndarray:
+    return np.array(jax.random.key_data(jax.random.key(seed)),
+                    np.uint32)
+
+
+class Scheduler:
+    """Continuous-batching policy over one `DecodeEngine`.
+
+    ``reserve="worst_case"`` (default) allocates every block a request
+    could ever need at admission — no mid-stream surprises, admission
+    defers while the pool is short. ``reserve="on_demand"`` allocates
+    for the prompt only and grows per block boundary during decode;
+    when the pool runs dry at a growth point the OLDEST slot preempts
+    the YOUNGEST one back to the queue and takes its blocks —
+    oldest-first progress guarantees the system drains, and replay is
+    deterministic (same seed, same tokens), so a preempted stream is
+    delayed, never corrupted.
+    """
+
+    def __init__(self, engine: DecodeEngine, reserve: str = "worst_case"):
+        if reserve not in ("worst_case", "on_demand"):
+            raise ValueError(f"reserve={reserve!r}")
+        self.engine = engine
+        self.cfg = engine.cfg
+        self.spec = engine.spec
+        self.reserve = reserve
+        self.alloc = BlockAllocator(self.spec)
+        C = self.cfg.capacity
+        self.tables = new_block_table(self.spec, C)
+        self.pos = np.zeros(C, np.int32)
+        self.decoding = np.zeros(C, bool)
+        self.temp = np.zeros(C, np.float32)
+        self.top_k = np.zeros(C, np.int32)
+        self.rngs = np.zeros((C, 2), np.uint32)
+        self.slots: Dict[int, _Slot] = {}
+        self.free_slots: List[int] = list(range(C))
+        self.queue: Deque[Tuple[Request, int]] = deque()  # (req, preempts)
+        self.prefill_order: Deque[int] = deque()          # slot ids
+        self.completions: List[Completion] = []
+        #: (rid, token) pairs emitted by the MOST RECENT tick — the
+        #: driver's streaming hook
+        self.last_emissions: List[Tuple[str, int]] = []
+        #: rids preempted by the MOST RECENT tick: a streaming consumer
+        #: must DISCARD its partial stream for these (the replay
+        #: regenerates it bitwise; keeping the prefix would duplicate
+        #: tokens — review finding, regression-pinned)
+        self.last_preemptions: List[str] = []
+        self._seq = 0
+        self._queue_wait: Dict[str, float] = {}
+        #: running occupancy: decoding-slot fraction summed over ticks
+        self._occupancy_sum = 0.0
+        self._ticks = 0
+
+    # ---- submission ------------------------------------------------------
+
+    def submit(self, req: Request) -> None:
+        total = req.prompt.size + req.max_new_tokens
+        if total > self.cfg.max_slot_len:
+            raise ValueError(
+                f"request {req.rid}: prompt {req.prompt.size} + "
+                f"max_new_tokens {req.max_new_tokens} exceeds the "
+                f"engine's max_slot_len {self.cfg.max_slot_len}")
+        if -(-total // self.spec.block_size) > self.spec.n_blocks - 1:
+            # even with the pool to itself this request cannot finish —
+            # admitting it would preempt-loop forever in on_demand mode
+            raise ValueError(
+                f"request {req.rid}: span {total} needs more blocks "
+                f"than the whole pool holds "
+                f"({self.spec.n_blocks - 1} usable)")
+        if req.arrival == 0.0:
+            req.arrival = time.perf_counter()
+        self.queue.append((req, 0))
+
+    def busy(self) -> bool:
+        return bool(self.queue or self.slots)
+
+    # ---- internals -------------------------------------------------------
+
+    def _blocks_needed_at_admit(self, req: Request) -> int:
+        if self.reserve == "worst_case":
+            span = req.prompt.size + req.max_new_tokens
+        else:
+            # prefill writes full chunks: cover the prompt rounded up
+            # to the chunk width (tail-chunk garbage lands in owned
+            # blocks), growth happens per decode block boundary
+            ch = self.cfg.prefill_chunk
+            span = min(-(-req.prompt.size // ch) * ch,
+                       self.cfg.max_slot_len)
+        return -(-span // self.spec.block_size)
+
+    def _admit(self) -> None:
+        while self.queue and self.free_slots:
+            req, preempts = self.queue[0]
+            need = self._blocks_needed_at_admit(req)
+            blocks = self.alloc.alloc(need)
+            if blocks is None:
+                return  # pool short: keep FIFO order, retry next tick
+            self.queue.popleft()
+            s = self.free_slots.pop(0)
+            self._seq += 1
+            slot = _Slot(req, blocks, preempts, self._seq)
+            self.slots[s] = slot
+            self.tables[s, :] = 0
+            self.tables[s, :len(blocks)] = blocks
+            self.pos[s] = 0
+            self.decoding[s] = False
+            self.temp[s] = req.temperature
+            self.top_k[s] = req.top_k or 0
+            self.rngs[s] = _key_data(req.seed)
+            self._queue_wait[req.rid] = (
+                slot.admitted_at - req.arrival if req.arrival else 0.0)
+            self.prefill_order.append(s)
+
+    def _grow(self, s: int, slot: _Slot) -> bool:
+        """Ensure the block covering ``pos`` exists before a decode
+        write. True = ok, False = pool empty (caller preempts)."""
+        idx = int(self.pos[s]) // self.spec.block_size
+        if idx < len(slot.blocks):
+            return True
+        got = self.alloc.alloc(1)
+        if got is None:
+            return False
+        slot.blocks.extend(got)
+        self.tables[s, idx] = got[0]
+        return True
+
+    def _preempt(self, s: int) -> None:
+        """Return a slot's request to the queue head for deterministic
+        replay from scratch (same seed -> same tokens; emitted-so-far
+        is discarded, the stream restarts delayed but identical)."""
+        slot = self.slots.pop(s)
+        self.last_preemptions.append(slot.req.rid)
+        self.alloc.free(slot.blocks)
+        self.tables[s, :] = 0
+        self.decoding[s] = False
+        self.pos[s] = 0
+        if s in self.prefill_order:
+            self.prefill_order.remove(s)
+        self.free_slots.append(s)
+        self.queue.appendleft((slot.req, slot.preempted + 1))
+
+    def _retire(self, s: int, reason: str) -> Completion:
+        slot = self.slots.pop(s)
+        now = time.perf_counter()
+        first = slot.first_token_at or now
+        comp = Completion(
+            rid=slot.req.rid,
+            tokens=list(slot.emitted),
+            finish_reason=reason,
+            queue_wait_s=self._queue_wait.pop(slot.req.rid, 0.0),
+            ttft_s=first - slot.admitted_at,
+            decode_s=now - first,
+            preempted=slot.preempted,
+        )
+        self.alloc.free(slot.blocks)
+        self.tables[s, :] = 0
+        self.decoding[s] = False
+        self.pos[s] = 0
+        self.free_slots.append(s)
+        self.completions.append(comp)
+        return comp
+
+    # ---- the tick --------------------------------------------------------
+
+    def tick(self) -> List[Completion]:
+        """Admit -> prefill-chunk pick -> engine step -> account.
+        Returns the requests that COMPLETED this tick."""
+        self.last_preemptions = []
+        self._admit()
+        # growth check before the step: every decoding slot must own
+        # the block its write lands in. On a dry pool a grower may only
+        # evict slots STRICTLY YOUNGER than itself (decoding or
+        # prefilling — a re-admitted request is always the youngest);
+        # with no younger victim it preempts ITSELF. The oldest slot is
+        # therefore never evicted and strictly progresses every tick,
+        # so the system drains — any policy that lets a younger grower
+        # evict an older slot (or the grower evict itself while holding
+        # victims) lets two oversubscribed requests cycle forever
+        # (observed livelock, test-pinned against).
+        for s in sorted([s for s in self.slots if self.decoding[s]],
+                        key=lambda s: self.slots[s].seq):
+            if s not in self.slots:
+                continue  # preempted as a victim earlier this tick
+            me = self.slots[s]
+            while not self._grow(s, me):
+                victims = [v for v in self.slots
+                           if self.slots[v].seq > me.seq]
+                if victims:
+                    self._preempt(max(
+                        victims, key=lambda v: self.slots[v].seq))
+                elif len(self.slots) > 1:
+                    # s is the youngest: yield its blocks to its elders
+                    self._preempt(s)
+                    break
+                else:
+                    # alone and still dry — unreachable when submit()
+                    # holds its pool-size invariant (a lone slot's span
+                    # fits the pool); requeueing would re-admit into
+                    # the same state forever, so fail loudly instead
+                    raise RuntimeError(
+                        f"request {me.req.rid} cannot grow with the "
+                        "pool to itself — engine pool is smaller than "
+                        "one request's span")
+        # one prefill chunk, FIFO over admitted-but-not-decoding slots
+        prefill = idle_prefill(self.cfg)
+        pf_slot = None
+        if self.prefill_order:
+            pf_slot = self.prefill_order[0]
+            slot = self.slots[pf_slot]
+            ptoks = slot.req.prompt
+            ppos = slot.prefill_next
+            ch = self.cfg.prefill_chunk
+            chunk_len = min(ch, ptoks.size - ppos)
+            # the engine writes the FULL ch-wide window: slide the
+            # window start back so it never crosses the slot end —
+            # otherwise the model's in-cache update and the pool
+            # scatter both clamp and scribble real prompt entries
+            # (review finding, regression-pinned). Re-sent rows
+            # recompute bitwise-identical K/V: each row's causal mask
+            # restricts it to the same context as its original pass.
+            start = min(ppos, self.cfg.max_slot_len - ch)
+            n_win = min(ch, ptoks.size - start)
+            chunk = np.zeros(ch, np.int32)
+            chunk[:n_win] = ptoks[start:start + n_win]
+            finished = ppos + chunk_len >= ptoks.size
+            last_row = (ptoks.size - 1 - start) if finished else -1
+            prefill = (np.int32(pf_slot), chunk, np.int32(start),
+                       np.int32(last_row))
+        was_decoding = self.decoding.copy()
+        emitted, self.rngs = self.engine.tick(
+            self.tables, self.pos, self.decoding, self.temp, self.top_k,
+            self.rngs, prefill)
+        self._occupancy_sum += float(was_decoding.mean())
+        self._ticks += 1
+        # prefill accounting
+        if pf_slot is not None:
+            slot = self.slots[pf_slot]
+            chunk_len = min(self.cfg.prefill_chunk,
+                            slot.req.prompt.size - slot.prefill_next)
+            slot.prefill_next += chunk_len
+            self.pos[pf_slot] += chunk_len
+            if slot.prefill_next >= slot.req.prompt.size:
+                self.prefill_order.popleft()
+                self.decoding[pf_slot] = True
+        # decode accounting
+        done: List[Completion] = []
+        self.last_emissions = []
+        for s in list(self.slots):
+            if not was_decoding[s]:
+                continue
+            slot = self.slots[s]
+            tok = int(emitted[s])
+            if slot.first_token_at is None:
+                slot.first_token_at = time.perf_counter()
+            slot.emitted.append(tok)
+            self.last_emissions.append((slot.req.rid, tok))
+            self.pos[s] += 1
+            req = slot.req
+            if req.eos_id is not None and tok == req.eos_id:
+                done.append(self._retire(s, "eos"))
+            elif len(slot.emitted) >= req.max_new_tokens:
+                done.append(self._retire(s, "length"))
+        return done
+
+    # ---- metrics ---------------------------------------------------------
+
+    @property
+    def slot_occupancy(self) -> float:
+        """Mean decoding-slot fraction over all ticks so far."""
+        return self._occupancy_sum / max(1, self._ticks)

@@ -251,6 +251,66 @@ def add_report_parser(sub) -> None:
                    default=argparse.SUPPRESS)
 
 
+def _pct(sorted_vals, q: float) -> float:
+    return sorted_vals[min(len(sorted_vals) - 1,
+                           int(q * len(sorted_vals)))]
+
+
+def build_serving_section(run_dir: str) -> Optional[Dict[str, Any]]:
+    """Per-request serving latency attribution when this run dir holds
+    serving telemetry (serve/driver.py): TTFT/TPOT percentiles from the
+    per-request decode spans (or the driver's serving.json summary) +
+    replica restarts + aggregate throughput. None when the run served
+    nothing — training runs keep their report unchanged."""
+    from ray_lightning_tpu.telemetry.spans import PH_DECODE, read_spans
+
+    tdir = telemetry_dir(run_dir)
+    base = run_dir if tdir != run_dir else os.path.dirname(run_dir)
+    summary = None
+    spath = os.path.join(base, "serving.json")
+    if os.path.exists(spath):
+        try:
+            with open(spath) as f:
+                summary = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            summary = None
+    per_req: Dict[str, dict] = dict((summary or {}).get("meta", {}))
+    if not per_req:
+        # fall back to the span files: decode spans carry the request
+        # meta (rid, ttft_s, tpot_s) at completion
+        for path in sorted(glob.glob(
+                os.path.join(tdir, "rank*.spans.jsonl"))):
+            try:
+                parsed = read_spans(path)
+            except OSError:
+                continue
+            for span in parsed["spans"]:
+                meta = span.get("meta") or {}
+                if span.get("phase") == PH_DECODE and "ttft_s" in meta:
+                    per_req[meta.get("rid", f"?{len(per_req)}")] = meta
+    if not per_req:
+        return None
+    ttfts = sorted(float(m.get("ttft_s", 0.0)) for m in per_req.values())
+    tpots = sorted(float(m.get("tpot_s", 0.0)) for m in per_req.values())
+    section: Dict[str, Any] = {
+        "requests": len(per_req),
+        "ttft_p50_s": round(_pct(ttfts, 0.50), 4),
+        "ttft_p95_s": round(_pct(ttfts, 0.95), 4),
+        "tpot_p50_s": round(_pct(tpots, 0.50), 4),
+        "tpot_p95_s": round(_pct(tpots, 0.95), 4),
+    }
+    if summary:
+        stats = summary.get("stats", {})
+        for key in ("decode_tokens_per_s", "slot_occupancy",
+                    "warmup_cold_s", "warmup_respawn_s"):
+            if stats.get(key) is not None:
+                section[key] = stats[key]
+        restarts = summary.get("restarts", {})
+        if restarts:
+            section["replica_restarts"] = restarts
+    return section
+
+
 def build_report(run_dir: str, preset: Optional[str] = None,
                  topo: str = "v5p-64", overlap: str = "off",
                  threshold: float = DRIFT_THRESHOLD) -> Dict[str, Any]:
@@ -265,6 +325,9 @@ def build_report(run_dir: str, preset: Optional[str] = None,
             for r, v in sorted(timeline["ranks"].items())},
         "goodput": gp.read_goodput(timeline["telemetry_dir"]),
     }
+    serving = build_serving_section(run_dir)
+    if serving:
+        out["serving"] = serving
     if preset:
         predicted = predicted_step_composition(preset, topo, overlap)
         out["drift"] = build_drift(predicted, timeline, threshold)
@@ -287,6 +350,18 @@ def _print_report(out: Dict[str, Any]) -> None:
     else:
         print("goodput: no assembled goodput.json (run was not "
               "supervised, or is still in flight)")
+    sv = out.get("serving")
+    if sv:
+        print(f"serving: {sv['requests']} request(s), TTFT p50 "
+              f"{sv['ttft_p50_s'] * 1e3:.1f} ms / p95 "
+              f"{sv['ttft_p95_s'] * 1e3:.1f} ms, TPOT p50 "
+              f"{sv['tpot_p50_s'] * 1e3:.1f} ms")
+        extras = ", ".join(
+            f"{k}={sv[k]}" for k in ("decode_tokens_per_s",
+                                     "slot_occupancy",
+                                     "replica_restarts") if k in sv)
+        if extras:
+            print(f"  {extras}")
     ss = out.get("step_stats")
     if ss:
         print(f"warm step time: mean {ss['mean_s'] * 1e3:.2f} ms / "

@@ -64,6 +64,21 @@ PHASES = (
     PH_EVAL, PH_BACKOFF, PH_ATTEMPT, PH_ROLLBACK, PH_STEP,
 )
 
+# ---- serving phases (serve/, docs/SERVING.md) -----------------------------
+# Recorded per REQUEST at completion (explicit record() calls on the
+# scheduler's measured host times, flushed on a cadence), never per
+# engine tick: the serving loop is a hot loop and RLT501's cadence
+# discipline applies to it too. Kept OUT of `PHASES` on purpose — the
+# training goodput buckets (telemetry/goodput.py) must not learn
+# request-scoped phases whose spans overlap each other by design.
+
+PH_QUEUE_WAIT = "queue_wait"    # request submitted -> slot admitted
+PH_PREFILL = "prefill"          # admitted -> prompt fully prefilled
+PH_DECODE = "decode"            # first sampled token -> retirement
+PH_DETOK = "detokenize"         # token ids -> text (driver side)
+
+SERVE_PHASES = (PH_QUEUE_WAIT, PH_PREFILL, PH_DECODE, PH_DETOK)
+
 #: phases recorded from background threads overlap with compute and must
 #: NOT be charged against the main thread's wall-time budget
 THREAD_MAIN = "main"

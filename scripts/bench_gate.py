@@ -59,6 +59,9 @@ RATCHETED = {
     "mfu": "mfu",
     "overlap_hidden_fraction": "overlap_hidden_fraction",
     "goodput_fraction": "goodput_fraction",
+    # serving leg (ISSUE 8): steady-state continuous-batching decode
+    # throughput — measured, so waived on environmental skip lines
+    "decode_tokens_per_s": "decode_tokens_per_s",
 }
 
 #: keys computed by static analysis (no hardware needed) — carried on
@@ -67,11 +70,17 @@ STATIC = {"overlap_hidden_fraction"}
 
 #: metric -> max allowed value on a measured (non-skip) line; absent or
 #: null waives (bench.py reports null when the probe itself failed) —
-#: the bound exists to stop telemetry from growing into real overhead,
-#: not to demand the field on every historic line
+#: each bound exists to stop a latency/overhead class from growing, not
+#: to demand the field on every historic line
 BOUNDED = {
     "telemetry_overhead_fraction": float(
         os.environ.get("RLT_BENCH_TELEMETRY_OVERHEAD_MAX", 0.01)),
+    # warm TTFT (serving leg, ISSUE 8): a request on the already-
+    # compiled engine — queue + prefill only. A growth here means the
+    # engine started recompiling (or prefill regressed) on the serving
+    # hot path.
+    "ttft_warm_s": float(
+        os.environ.get("RLT_BENCH_TTFT_WARM_MAX", 2.0)),
 }
 
 
@@ -119,11 +128,10 @@ def best_prior(prior_glob: str, repo_root: str) -> dict:
         except (TypeError, ValueError):  # "value": null / non-numeric
             measured = False
         for name, key in RATCHETED.items():
-            # value/mfu are measurements — only success lines count;
-            # overlap_hidden_fraction is static analysis — any line
+            # measurements count only from success lines; STATIC
+            # metrics (computed without hardware) from any line
             v = line.get(key)
-            if v is None or (key != "overlap_hidden_fraction"
-                             and not measured):
+            if v is None or (key not in STATIC and not measured):
                 continue
             try:
                 v = float(v)
@@ -192,10 +200,13 @@ def gate(fresh: dict, best: dict, tolerance: float) -> list[str]:
             failures.append(f"{key}: non-numeric value {v!r}")
             continue
         if v > bound:
+            what = ("telemetry is eating the step time it exists to "
+                    "measure" if key == "telemetry_overhead_fraction"
+                    else "the serving warm path regressed (recompile "
+                    "or prefill growth on the request hot path)")
             failures.append(
                 f"{key}: {v:g} exceeds the {bound:g} upper bound — "
-                "telemetry is eating the step time it exists to "
-                "measure")
+                f"{what}")
     return failures
 
 

@@ -582,3 +582,106 @@ def test_rlt402_suppressible():
         "  # rlt: disable=RLT402\n"
         "        return y.sum()\n")
     assert "RLT402" not in rules_of(fs)
+
+
+# ---- RLT502 serve-loop recompile ----------------------------------------
+
+
+def test_rlt502_growing_concat_fires():
+    fs = lint(
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "step = jax.jit(lambda p, t: t)\n"
+        "def serve(params, prompt):\n"
+        "    toks = prompt\n"
+        "    for t in range(16):\n"
+        "        logits = step(params, toks)\n"
+        "        toks = jnp.concatenate([toks, logits[:, None]], axis=1)\n"
+        "    return toks\n")
+    assert "RLT502" in rules_of(fs)
+
+
+def test_rlt502_unbucketed_slice_fires():
+    fs = lint(
+        "import jax\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, static_argnums=())\n"
+        "def prefill(params, toks):\n"
+        "    return toks\n"
+        "def serve(params, toks, lens):\n"
+        "    for i, l in enumerate(lens):\n"
+        "        out = prefill(params, toks[:, :l])\n")
+    assert "RLT502" in rules_of(fs)
+
+
+def test_rlt502_while_loop_fires():
+    fs = lint(
+        "import jax\n"
+        "import numpy as np\n"
+        "decode = jax.jit(lambda p, t: t)\n"
+        "def serve(params, toks):\n"
+        "    done = False\n"
+        "    while not done:\n"
+        "        out = decode(params, toks)\n"
+        "        toks = np.concatenate([toks, out])\n")
+    assert "RLT502" in rules_of(fs)
+
+
+def test_rlt502_fixed_shapes_clean():
+    # position-indexed cache writes + integer indexing: shapes constant
+    fs = lint(
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "step = jax.jit(lambda p, t: t)\n"
+        "def serve(params, toks):\n"
+        "    out = jnp.zeros((4, 16), jnp.int32)\n"
+        "    for t in range(16):\n"
+        "        tok = step(params, toks)\n"
+        "        out = out.at[:, t].set(tok)\n"
+        "        x = step(params, out[t])\n"
+        "    return out\n")
+    assert "RLT502" not in rules_of(fs)
+
+
+def test_rlt502_quiet_in_traced_code_and_nonjit_callees():
+    # inside jit, loop shapes are static by construction; and a plain
+    # (unjitted) python callee retraces nothing
+    fs = lint(
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def traced(params, toks):\n"
+        "    for t in range(4):\n"
+        "        toks = jnp.concatenate([toks, toks], axis=1)\n"
+        "    return toks\n"
+        "def plain(params, toks):\n"
+        "    for t in range(4):\n"
+        "        toks = jnp.concatenate([toks, helper(params, toks)])\n")
+    assert "RLT502" not in rules_of(fs)
+
+
+def test_rlt502_suppressible():
+    fs = lint(
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "step = jax.jit(lambda p, t: t)\n"
+        "def serve(params, toks):\n"
+        "    for t in range(4):\n"
+        "        out = step(params, toks)  # rlt: disable=RLT502\n"
+        "        toks = jnp.concatenate([toks, out])\n")
+    assert "RLT502" not in rules_of(fs)
+
+
+def test_rlt502_outer_loop_variable_in_nested_loop_fires():
+    # review regression: the canonical per-request-outer /
+    # per-token-inner serve loop — the slice varies with the OUTER
+    # loop's un-bucketed length
+    fs = lint(
+        "import jax\n"
+        "step = jax.jit(lambda p, t: t)\n"
+        "def serve(params, toks, lens):\n"
+        "    for l in lens:\n"
+        "        done = False\n"
+        "        while not done:\n"
+        "            out = step(params, toks[:, :l])\n")
+    assert "RLT502" in rules_of(fs)

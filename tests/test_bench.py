@@ -377,3 +377,28 @@ def test_attnout_leg_fallback_and_double_failure_chaining():
     row, m = bench._attnout_leg(ok, lambda t, c: 0.6)
     assert row["flagship_attnout_tokens_per_sec"] == 1200.0
     assert "flagship_attnout_inline_error" not in row
+
+
+def test_skip_line_carries_serving_schema(monkeypatch, capsys):
+    """ISSUE 8: every bench JSON line — including the backend-down skip
+    — carries the serving section (schema + the flagship serve plan),
+    so a round with no chip still documents what the serving leg will
+    measure when one returns."""
+
+    def unavailable():
+        raise bench.BackendUnavailable("jax backend unavailable")
+
+    monkeypatch.setattr(bench, "_backend_with_retry", unavailable)
+    monkeypatch.setenv("RLT_BENCH_WATCHDOG_S", "0")
+    with pytest.raises(SystemExit):
+        bench.main()
+    obj = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert obj["skipped"] == "backend unavailable"
+    serving = obj.get("serving")
+    assert serving is not None, obj.get("serving_error")
+    assert set(serving["schema"]) == {
+        "decode_tokens_per_s", "ttft_cold_s", "ttft_warm_s",
+        "slot_occupancy"}
+    assert serving["flagship_plan"]["pool_bytes"] > 0
+    # measured serving values belong to success lines only
+    assert "decode_tokens_per_s" not in obj

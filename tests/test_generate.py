@@ -82,6 +82,94 @@ def test_module_level_generate(tiny):
     assert np.asarray(out).shape == (2, 3)
 
 
+# ---- sampling path (ISSUE 8 satellite): the serving engine's
+# ---- single-stream reference behaviors --------------------------------
+
+
+def test_sampling_same_key_same_tokens(tiny):
+    """Seeded sampling is reproducible: same key => same tokens; a
+    different key (very probably) differs."""
+    cfg, model, params, prompt = tiny
+    a = np.asarray(generate(model, params, prompt, 8, temperature=0.9,
+                            top_k=8, seed=5))
+    b = np.asarray(generate(model, params, prompt, 8, temperature=0.9,
+                            top_k=8, seed=5))
+    np.testing.assert_array_equal(a, b)
+    c = np.asarray(generate(model, params, prompt, 8, temperature=0.9,
+                            top_k=8, seed=6))
+    assert not np.array_equal(a, c)
+
+
+def test_top_k_one_is_greedy(tiny):
+    """top_k=1 collapses sampling to argmax regardless of temperature
+    or seed — pins the threshold-filter semantics."""
+    cfg, model, params, prompt = tiny
+    greedy = np.asarray(generate(model, params, prompt, 6))
+    for seed in (0, 9):
+        sampled = np.asarray(generate(model, params, prompt, 6,
+                                      temperature=1.3, top_k=1,
+                                      seed=seed))
+        np.testing.assert_array_equal(sampled, greedy)
+
+
+def test_donated_cache_and_cache_len(tiny):
+    """The cache is donated through the decode program and its length
+    is an explicit knob: any cache_len >= prompt + max_new decodes
+    identically (the tail is masked context no query ever sees)."""
+    cfg, model, params, prompt = tiny
+    base = np.asarray(generate(model, params, prompt, 6))
+    padded = np.asarray(generate(model, params, prompt, 6,
+                                 cache_len=prompt.shape[1] + 6 + 9))
+    np.testing.assert_array_equal(padded, base)
+    with pytest.raises(ValueError, match="cache_len"):
+        generate(model, params, prompt, 6, cache_len=7)
+
+
+# ---- ragged left-padded prefill (ISSUE 8 satellite) -------------------
+
+
+def test_left_padded_ragged_batch_matches_unpadded(tiny):
+    """A left-padded ragged batch decodes row-for-row exactly like each
+    unpadded prompt on its own — the batched-prefill reference the
+    serving engine is validated against."""
+    cfg, model, params, _ = tiny
+    lens = [3, 8, 5]
+    prompts = [np.asarray(jax.random.randint(
+        jax.random.key(70 + i), (l,), 0, cfg.vocab_size), dtype=np.int32)
+        for i, l in enumerate(lens)]
+    s0 = max(lens)
+    padded = np.zeros((3, s0), np.int32)
+    for i, p in enumerate(prompts):
+        padded[i, s0 - len(p):] = p
+    out = np.asarray(generate(model, params, jnp.asarray(padded), 5,
+                              prompt_lengths=lens))
+    for i, p in enumerate(prompts):
+        ref = np.asarray(generate(model, params, p[None], 5))[0]
+        np.testing.assert_array_equal(out[i], ref, err_msg=f"row {i}")
+
+
+def test_left_padded_full_length_row_matches_plain(tiny):
+    """A row with zero padding through the padded program equals the
+    plain unpadded program — the pad machinery is inert at pad=0."""
+    cfg, model, params, prompt = tiny
+    out = np.asarray(generate(model, params, prompt, 5,
+                              prompt_lengths=[prompt.shape[1]] * 2))
+    ref = np.asarray(generate(model, params, prompt, 5))
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_prompt_lengths_shape_validated(tiny):
+    cfg, model, params, prompt = tiny
+    with pytest.raises(ValueError, match="prompt_lengths"):
+        generate(model, params, prompt, 4, prompt_lengths=[3])
+    # out-of-range lengths would silently shift RoPE positions
+    with pytest.raises(ValueError, match="within"):
+        generate(model, params, prompt, 4,
+                 prompt_lengths=[prompt.shape[1] + 1, 2])
+    with pytest.raises(ValueError, match="within"):
+        generate(model, params, prompt, 4, prompt_lengths=[0, 2])
+
+
 @pytest.mark.slow  # second full decode compile; scan-variant stays non-slow
 def test_generate_nonscan_layers():
     """The per-layer (non-scan) code path decodes identically too."""

@@ -1,0 +1,179 @@
+"""Replica-group driver tests (serve/driver.py): inline multiplexing,
+params round-trip, telemetry spans + the report serving section, and —
+slow, real processes — the injected-SIGKILL respawn/replay drill."""
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.models.llama import Llama, LlamaConfig, generate
+from ray_lightning_tpu.serve.driver import (
+    ReplicaGroupConfig,
+    ServeDriver,
+    load_params_npz,
+    save_params_npz,
+)
+from ray_lightning_tpu.serve.engine import EngineConfig
+from ray_lightning_tpu.serve.scheduler import Request
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = LlamaConfig.tiny(use_flash=False, dtype=jnp.float32)
+    model = Llama(cfg)
+    prompts = [
+        np.array(jax.random.randint(
+            jax.random.key(60 + i), (1, 3 + (i % 4)), 0,
+            cfg.vocab_size), dtype=np.int32)
+        for i in range(8)
+    ]
+    params = jax.jit(model.init)(jax.random.key(2), prompts[0])["params"]
+    return cfg, model, params, prompts
+
+
+ECFG = EngineConfig(capacity=2, block_size=4, blocks_per_slot=8,
+                    prefill_chunk=4)
+
+
+def _requests(prompts, max_new=6):
+    return [Request(rid=f"r{i}", prompt=p[0], max_new_tokens=max_new,
+                    temperature=0.6 if i % 2 else 0.0,
+                    top_k=3 if i % 2 else None, seed=5 + i)
+            for i, p in enumerate(prompts)]
+
+
+def _refs(model, params, prompts, reqs):
+    return {r.rid: np.asarray(generate(
+        model, params, prompts[i], r.max_new_tokens,
+        temperature=r.temperature, top_k=r.top_k, seed=r.seed))[0]
+        for i, r in enumerate(reqs)}
+
+
+def test_params_npz_roundtrip_exact(setup, tmp_path):
+    cfg, model, params, _ = setup
+    path = str(tmp_path / "p.npz")
+    save_params_npz(params, path)
+    loaded = load_params_npz(path)
+    flat_a = jax.tree.leaves(params)
+    flat_b = jax.tree.leaves(loaded)
+    assert len(flat_a) == len(flat_b)
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_inline_two_replicas_parity_and_summary(setup, tmp_path):
+    cfg, model, params, prompts = setup
+    reqs = _requests(prompts)
+    refs = _refs(model, params, prompts, reqs)
+    run_dir = str(tmp_path / "run")
+    drv = ServeDriver(cfg, params, ReplicaGroupConfig(
+        n_replicas=2, backend="inline", engine=ECFG, run_dir=run_dir))
+    res = drv.run(reqs)
+    for rid, ref in refs.items():
+        np.testing.assert_array_equal(np.array(res.outputs[rid]), ref,
+                                      err_msg=rid)
+    assert res.stats["n_tokens"] == sum(len(v) for v in
+                                        res.outputs.values())
+    assert res.stats["compile_count"] in (1, -1)
+    # summary + spans on disk, and the report CLI surfaces them
+    assert os.path.exists(os.path.join(run_dir, "serving.json"))
+    with open(os.path.join(run_dir, "serving.json")) as f:
+        summary = json.load(f)
+    assert summary["stats"]["n_requests"] == 8
+    from ray_lightning_tpu.telemetry.report import build_serving_section
+
+    section = build_serving_section(run_dir)
+    assert section is not None
+    assert section["requests"] == 8
+    assert section["ttft_p95_s"] >= section["ttft_p50_s"] >= 0
+
+
+def test_run_does_not_mutate_caller_requests(setup):
+    """Review regression: run() copies requests before stamping
+    arrival, so the same list serves two runs with sane queue_wait
+    both times (a stale first-run stamp used to inflate the second
+    run's queue_wait by the whole first run's wall)."""
+    cfg, model, params, prompts = setup
+    reqs = _requests(prompts[:2], max_new=3)
+    drv = ServeDriver(cfg, params, ReplicaGroupConfig(
+        n_replicas=1, backend="inline", engine=ECFG))
+    res1 = drv.run(reqs)
+    assert all(r.arrival == 0.0 for r in reqs), "caller objects mutated"
+    res2 = drv.run(reqs)
+    for rid in res1.outputs:
+        assert res1.outputs[rid] == res2.outputs[rid]
+        # queue_wait is per-run: bounded by THIS run's wall, never the
+        # inter-run gap a stale stamp would add
+        assert (0.0 <= res2.meta[rid]["queue_wait_s"]
+                <= res2.stats["wall_s"] + 0.5)
+
+
+def test_inline_rejects_fault(setup):
+    cfg, model, params, prompts = setup
+    drv = ServeDriver(cfg, params, ReplicaGroupConfig(
+        n_replicas=1, backend="inline", engine=ECFG))
+    with pytest.raises(ValueError, match="process"):
+        drv.run(_requests(prompts[:1]),
+                fault={"replica": 0, "kill_after_tokens": 1})
+
+
+def test_process_backend_requires_params_path(setup):
+    cfg, model, params, _ = setup
+    with pytest.raises(ValueError, match="npz"):
+        ServeDriver(cfg, params, ReplicaGroupConfig(
+            n_replicas=1, backend="process", engine=ECFG))
+
+
+def test_serving_spans_flushed(setup, tmp_path):
+    """Per-request serving spans land in the recorder files with the
+    request meta the report aggregates."""
+    from ray_lightning_tpu.telemetry.spans import (
+        PH_DECODE, PH_PREFILL, PH_QUEUE_WAIT, read_spans,
+    )
+
+    cfg, model, params, prompts = setup
+    run_dir = str(tmp_path / "run")
+    drv = ServeDriver(cfg, params, ReplicaGroupConfig(
+        n_replicas=1, backend="inline", engine=ECFG, run_dir=run_dir))
+    drv.run(_requests(prompts[:3], max_new=4))
+    import glob
+
+    files = glob.glob(os.path.join(run_dir, "telemetry",
+                                   "rank*.spans.jsonl"))
+    assert files
+    spans = [s for f in files for s in read_spans(f)["spans"]]
+    phases = {s["phase"] for s in spans}
+    assert {PH_QUEUE_WAIT, PH_PREFILL, PH_DECODE} <= phases
+    decode = [s for s in spans if s["phase"] == PH_DECODE]
+    assert len(decode) == 3
+    assert all("ttft_s" in (s.get("meta") or {}) for s in decode)
+
+
+@pytest.mark.slow
+def test_process_replica_kill_respawns_and_replays(setup, tmp_path):
+    """The recovery drill with real processes: SIGKILL replica 1 after
+    6 tokens -> classified RETRYABLE -> respawn reloads weights from
+    the npz and re-warms via the persistent compile cache -> the lost
+    streams replay bitwise; the surviving replica never restarts."""
+    cfg, model, params, prompts = setup
+    reqs = _requests(prompts)
+    refs = _refs(model, params, prompts, reqs)
+    pp = str(tmp_path / "params.npz")
+    save_params_npz(params, pp)
+    drv = ServeDriver(cfg, pp, ReplicaGroupConfig(
+        n_replicas=2, backend="process", engine=ECFG,
+        run_dir=str(tmp_path / "run"),
+        compile_cache_dir=str(tmp_path / "cc"),
+        env={"JAX_PLATFORMS": "cpu"}))
+    res = drv.run(reqs, fault={"replica": 1, "kill_after_tokens": 6})
+    assert res.restarts[1] >= 1, "kill did not trigger a respawn"
+    assert res.restarts[0] == 0, "the surviving replica restarted"
+    for rid, ref in refs.items():
+        np.testing.assert_array_equal(np.array(res.outputs[rid]), ref,
+                                      err_msg=rid)
+    assert res.stats["warmup_respawn_s"] is not None
