@@ -371,6 +371,55 @@ def _trace_summary() -> dict:
                 f"{type(exc).__name__}: {str(exc)[:200]}"}
 
 
+def _multislice_summary() -> dict:
+    """Static multi-slice (DCN) trace summary for the bench JSON
+    (ISSUE 9): the bench model's HSDP step on a 2xv5p-64 deployment —
+    `data` across the two slices (hierarchical gradient reduction on
+    DCN), fsdp inside each slice on ICI — itemized by network tier.
+    Pure jaxpr work like `_trace_summary`, carried on every line
+    (success or backend-down skip), with the headline
+    `dcn_bytes_per_step` duplicated at top level for the bench_gate
+    ceiling ratchet (DCN bytes may only shrink)."""
+    try:
+        from ray_lightning_tpu.analysis.costmodel import parse_topology
+        from ray_lightning_tpu.analysis.tracecheck import audit_step
+        from ray_lightning_tpu.models.llama import LlamaModule
+        from ray_lightning_tpu.parallel.strategy import ShardedMesh
+
+        topo = parse_topology("2xv5p-64")
+        cfg = _bench_cfg(use_flash=True, fused_ce=True, seq=2048,
+                         remat=True, scan=True)
+        per_slice = topo.devices_per_slice
+        report = audit_step(
+            LlamaModule(cfg),
+            ShardedMesh(data=topo.n_slices, fsdp=per_slice),
+            {"tokens": np.zeros((topo.n_devices, 2049), np.int32)},
+            topology=topo, label="bench 2xv5p-64 HSDP")
+        from ray_lightning_tpu.parallel.plan import dcn_crossing_axes
+
+        # the mesh axes (other than `data`, whose crossing is the
+        # designed HSDP placement) that span slices — empty when the
+        # placement is sound; non-empty mirrors an RLT306 flag
+        crossing = sorted(ax for ax in dcn_crossing_axes(
+            report.mesh_axes, topo.n_slices) if ax != "data")
+        return {
+            "dcn_bytes_per_step": report.dcn_bytes_per_step,
+            "multislice": {
+                "topology": topo.name,
+                "n_slices": topo.n_slices,
+                "mesh": report.mesh_axes,
+                "ici_bytes_per_step": report.ici_bytes_per_step,
+                "dcn_bytes_per_step": report.dcn_bytes_per_step,
+                "dcn_gbps_per_chip": topo.dcn_gbps,
+                "dcn_crossing_flags": crossing,
+                "findings": len(report.findings),
+            },
+        }
+    except Exception as exc:  # noqa: BLE001 — advisory data only
+        return {"multislice_error":
+                f"{type(exc).__name__}: {str(exc)[:200]}"}
+
+
 def _overlap_summary(cfg, topology_for_kind) -> dict:
     """Static overlap audit for the bench JSON (ISSUE 6): the bench
     model's ZeRO step on an 8-chip FSDP slice with the double-buffered
@@ -724,6 +773,7 @@ def main() -> None:
     # any backend touch, so skip/error lines carry analysis data too
     _install_kill_handlers()
     _ANALYSIS.update(_trace_summary())
+    _ANALYSIS.update(_multislice_summary())
     _ANALYSIS.update(_guard_summary())
     _ANALYSIS.update(_telemetry_summary())
     _ANALYSIS.update(_serve_summary())
@@ -1033,6 +1083,63 @@ def _run(sink: dict | None = None) -> dict:
         # content-independent.
         return _measure_serving()
 
+    def _reshard():
+        # elastic leg (elastic/, docs/ELASTIC.md, ISSUE 9): time a
+        # cross-topology checkpoint restore on THIS backend — save a
+        # provenance-stamped state on the full local mesh, restore it
+        # onto a half-size mesh (or same-size on one device), report
+        # wall seconds. The number the elastic supervisor pays per
+        # shrink/grow; bench_gate bounds it.
+        import shutil
+        import tempfile
+
+        import jax
+        import jax.numpy as jnp
+
+        from ray_lightning_tpu.checkpoint.io import (
+            save_checkpoint,
+            sharding_provenance,
+            wait_for_checkpoints,
+        )
+        from ray_lightning_tpu.elastic.reshard import reshard_restore
+        from ray_lightning_tpu.parallel.strategy import FSDP
+
+        n = len(jax.devices())
+        src = FSDP(min_shard_size=8)
+        src.setup()
+        # ~32 MiB of params: big enough that the restore is I/O, small
+        # enough to never disturb the throughput legs
+        params = {"w": jnp.arange(8 * 1024 * 1024,
+                                  dtype=jnp.float32).reshape(2048, -1)}
+        params = src.shard_params(params)
+        state = {"params": params,
+                 "step": jax.device_put(jnp.zeros((), jnp.int32),
+                                        src.replicated())}
+        d = tempfile.mkdtemp(prefix="rlt_bench_reshard_")
+        try:
+            ck = os.path.join(d, "ck")
+            save_checkpoint(ck, state,
+                            {"global_step": 0,
+                             **sharding_provenance(src.mesh, state)})
+            wait_for_checkpoints()
+            dst = FSDP(num_workers=max(1, n // 2), min_shard_size=8)
+            dst.setup()
+            tgt = {"params": dst.shard_params(
+                       jax.tree.map(jnp.zeros_like,
+                                    jax.device_get(params))),
+                   "step": jax.device_put(jnp.zeros((), jnp.int32),
+                                          dst.replicated())}
+            t0 = time.perf_counter()
+            restored = reshard_restore(ck, tgt)
+            jax.block_until_ready(restored)
+            dt = time.perf_counter() - t0
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+        return {"reshard_restore_s": round(dt, 4),
+                "reshard": {"from_world": n,
+                            "to_world": max(1, n // 2),
+                            "bytes": int(8 * 1024 * 1024 * 4)}}
+
     leg("vs_baseline", _baseline)
     leg("s4096", _s4k)
     leg("v128k", _v128k)
@@ -1041,6 +1148,7 @@ def _run(sink: dict | None = None) -> dict:
     leg("flagship_attnout", _flagship_attnout)
     leg("overlap", _overlap)
     leg("serving", _serving)
+    leg("reshard", _reshard)
 
     # Self-consistency (VERDICT r3 weak #1): the probe is a THROUGHPUT
     # ceiling; any model leg reading more effective FLOP/s than the bare

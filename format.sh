@@ -99,6 +99,35 @@ JAX_PLATFORMS=cpu python -m ray_lightning_tpu monitor --smoke > /dev/null
 # must audit clean under tracecheck (no RLT301/RLT303).
 JAX_PLATFORMS=cpu python -m ray_lightning_tpu serve --smoke > /dev/null
 
+# elastic gate (docs/ELASTIC.md): an 8-device fsdp=8 CPU-SPMD
+# checkpoint must reshard-restore onto a 4-device fsdp=4 mesh with
+# every param/opt-state leaf BITWISE-equal to the source, and training
+# must continue from it; a supervised 2-proc run with an injected
+# worker kill and the same-size relaunch budget exhausted
+# (max_restarts=0) must consult its ElasticBudget, reshard onto the
+# survivor (world 2 -> 1), resume, and converge — with the world
+# change in the reshard ledger and the reshard_s goodput bucket.
+JAX_PLATFORMS=cpu python -m ray_lightning_tpu elastic --smoke > /dev/null
+
+# multi-slice (DCN) trace gate: the flagship step on a 2-slice
+# deployment must itemize DCN vs ICI bytes as separate tiers, place
+# `data` across the slices (HSDP — hierarchical gradient reduction is
+# the only cross-slice traffic), and audit clean of errors.
+JAX_PLATFORMS=cpu python -m ray_lightning_tpu trace llama3-8b \
+    --topo 2xv5p-64 --json --fail-on error \
+    | python -c '
+import json, sys
+r = json.load(sys.stdin)
+assert r["ok"], "2xv5p-64 trace failed its own gate"
+assert r["topology"]["n_slices"] == 2, "slice count not parsed"
+assert r["dcn_bytes_per_step"] > 0, "no DCN tier itemized"
+assert not any(f["rule"] == "RLT306" for f in r["findings"]), \
+    "data-across-slices placement flagged RLT306"
+gib = 1024 ** 3
+ici, dcn = r["ici_bytes_per_step"] / gib, r["dcn_bytes_per_step"] / gib
+print(f"dcn gate: ICI {ici:.1f} GiB/step + DCN {dcn:.3f} GiB/step, "
+      "audits clean")'
+
 # prefetch-overlap + collective-overlap smoke: a slow-loader CPU run
 # must show pipeline occupancy > 0 (the device prefetcher demonstrably
 # kept batches resident ahead of the step), the overlap jaxpr must

@@ -50,6 +50,8 @@ from ray_lightning_tpu.resilience import (
 )
 from ray_lightning_tpu import telemetry
 from ray_lightning_tpu.telemetry import ProfileConfig, TelemetryConfig
+from ray_lightning_tpu import elastic
+from ray_lightning_tpu.elastic import ElasticBudget, reshard_restore
 
 __version__ = "0.1.0"
 
@@ -92,5 +94,8 @@ __all__ = [
     "telemetry",
     "TelemetryConfig",
     "ProfileConfig",
+    "elastic",
+    "ElasticBudget",
+    "reshard_restore",
     "__version__",
 ]

@@ -58,6 +58,14 @@ injected replica SIGKILL auto-recovers, decode step audits clean):
     python -m ray_lightning_tpu serve llama3-8b --topo v5p-8
     python -m ray_lightning_tpu serve --smoke
 
+``elastic`` runs the elastic-training smoke gate (elastic/,
+docs/ELASTIC.md): an 8-device checkpoint must reshard-restore onto a
+4-device mesh bitwise and keep training, and a supervised 2-process
+run whose retry budget refuses a same-size relaunch must shrink onto
+the survivor world and converge:
+
+    python -m ray_lightning_tpu elastic --smoke
+
 ``report`` / ``monitor`` read the telemetry a run left behind
 (telemetry/, docs/OBSERVABILITY.md): the goodput classification of
 supervised wall time, per-rank span timelines, and — with
@@ -502,6 +510,9 @@ def main(argv=None) -> int:
     from ray_lightning_tpu.analysis.cli import (
         add_lint_parser, add_trace_parser, run_lint, run_trace,
     )
+    from ray_lightning_tpu.elastic.cli import (
+        add_elastic_parser, run_elastic,
+    )
     from ray_lightning_tpu.pipeline.cli import add_perf_parser, run_perf
     from ray_lightning_tpu.resilience.cli import (
         add_supervise_parser, run_supervise,
@@ -518,6 +529,7 @@ def main(argv=None) -> int:
     add_serve_parser(sub)
     add_report_parser(sub)
     add_monitor_parser(sub)
+    add_elastic_parser(sub)
     args = p.parse_args(argv)
     if args.cmd == "plan":
         return run_plan(args)
@@ -535,6 +547,8 @@ def main(argv=None) -> int:
         return run_report(args)
     if args.cmd == "monitor":
         return run_monitor(args)
+    if args.cmd == "elastic":
+        return run_elastic(args)
     info = collect(probe=args.probe)
     if args.as_json:
         print(json.dumps(info))

@@ -170,6 +170,14 @@ def _build_llama_fsdp(topo, overlap: str = "off"):
     from ray_lightning_tpu.parallel.strategy import ShardedMesh
 
     n = topo.n_devices
+    # Multi-slice topologies (--topo 2xv5p-64): HSDP — the `data` axis
+    # spans the slices (only gradient all-reduces cross DCN,
+    # hierarchically reduced), fsdp stays inside each slice on ICI.
+    # This is the placement the mesh layer enforces on real multi-slice
+    # hardware (parallel/mesh.py order_devices_for_slices) and the one
+    # tracecheck audits clean; an fsdp axis across slices flags RLT306.
+    data = getattr(topo, "n_slices", 1)
+    fsdp = n // data
     if n >= 16:
         # the BASELINE.json north-star config: 8B, remat+scan+fused CE,
         # flash attention (the program the TPU actually runs), one
@@ -178,14 +186,17 @@ def _build_llama_fsdp(topo, overlap: str = "off"):
             remat=True, scan_layers=True, fused_ce=True, use_flash=True,
             max_seq_len=8192)
         batch, seq = n, 8192
-        label = f"llama3-8b FSDP({n})"
+        label = (f"llama3-8b HSDP(data={data},fsdp={fsdp})" if data > 1
+                 else f"llama3-8b FSDP({n})")
     else:
         cfg = LlamaConfig.tiny(use_flash=True)
         batch, seq = 2 * n, min(256, cfg.max_seq_len)
-        label = f"llama-tiny FSDP({n})"
+        label = (f"llama-tiny HSDP(data={data},fsdp={fsdp})" if data > 1
+                 else f"llama-tiny FSDP({n})")
     if overlap != "off":
         label += f" overlap={overlap}"
-    return (LlamaModule(cfg), ShardedMesh(fsdp=n, overlap=overlap),
+    return (LlamaModule(cfg),
+            ShardedMesh(data=data, fsdp=fsdp, overlap=overlap),
             {"tokens": np.zeros((batch, seq + 1), np.int32)}, label)
 
 
@@ -264,7 +275,10 @@ def add_trace_parser(sub) -> None:
              "(module, strategy, example_batch)")
     p.add_argument(
         "--topo", default="v5p-8",
-        help="target topology <family>-<chips>, e.g. v5p-64 "
+        help="target topology <family>-<chips>, e.g. v5p-64, or a "
+             "multi-slice deployment <slices>x<family>-<chips>, e.g. "
+             "2xv5p-64 — two slices joined over DCN; the trace then "
+             "itemizes ICI vs DCN bytes per step "
              "(families: v3 v4 v5e v5p v6e cpu)")
     p.add_argument(
         "--overlap", choices=("off", "on", "serial"), default="off",

@@ -21,9 +21,17 @@ Model assumptions (documented in docs/STATIC_ANALYSIS.md):
     moves (n-1)/n;
   * latency = hops x per-hop ICI latency + wire_bytes / bandwidth, with
     hops = n-1 for ring collectives and 1 for a neighbor permute;
-  * DCN (multi-slice) is out of scope: tracecheck audits one slice, the
-    mesh layer already refuses meshes whose non-data axes span slices
-    (parallel/mesh.py order_devices_for_slices);
+  * DCN (multi-slice): ``parse_topology("2xv5p-64")`` is TWO v5p-64
+    slices joined over the data-center network — 128 chips, two network
+    tiers. A collective whose group spans slices is priced
+    HIERARCHICALLY (the standard two-level ring): the intra-slice stage
+    over n/s members rides ICI, the inter-slice stage over s slices
+    rides DCN on the already-reduced/sharded payload (payload/n_intra
+    per chip). DCN bandwidth/latency figures are per-chip share of the
+    published inter-slice fabric — an order of magnitude below ICI,
+    which is exactly why the mesh layer places only the `data` axis
+    across slices (parallel/mesh.py order_devices_for_slices) and
+    tracecheck flags any OTHER axis crossing the boundary (RLT306);
   * the overlap model (`compute_time_us`, consumed by tracecheck's
     hidden-vs-exposed classification): a scanned body's per-trip compute
     window is its counted matmul FLOPs (dot_general only — pallas
@@ -42,9 +50,9 @@ from typing import Dict, Mapping, Optional, Tuple
 from ray_lightning_tpu.parallel.plan import hbm_bytes_for_kind
 
 __all__ = [
-    "Topology", "CollectiveCost", "ICI_SPECS", "MXU_EFFICIENCY",
-    "parse_topology", "topology_for_kind", "collective_cost",
-    "compute_time_us",
+    "Topology", "CollectiveCost", "ICI_SPECS", "DCN_SPECS",
+    "MXU_EFFICIENCY", "parse_topology", "topology_for_kind",
+    "collective_cost", "compute_time_us",
 ]
 
 #: ICI spec sheet per device family: (device_kind for the HBM table,
@@ -67,6 +75,26 @@ ICI_SPECS: Dict[str, Tuple[str, float, float]] = {
 #: ICI_SPECS' first column)
 _KIND_TO_FAMILY = {kind: fam for fam, (kind, _, _) in ICI_SPECS.items()}
 
+#: DCN (inter-slice) figures per family: (GB/s per chip, per-hop latency
+#: in microseconds). These model each chip's SHARE of the slice's
+#: data-center-network uplink under a hierarchical collective (every
+#: chip drives its own inter-slice ring on its reduce-scattered shard) —
+#: deliberately coarse, an order of magnitude below ICI, because the
+#: number that matters is the TIER RATIO: it is what makes a tensor/fsdp
+#: axis across DCN a performance cliff and a data axis across DCN a
+#: tolerable gradient-reduction tax ("Exploring the limits of
+#: Concurrency in ML Training on Google TPUs"; TorchTitan HSDP).
+#: "cpu" keeps CI runnable with visible-but-tiny figures.
+DCN_SPECS: Dict[str, Tuple[float, float]] = {
+    "v3": (6.25, 50.0),
+    "v4": (12.5, 50.0),
+    "v5e": (6.25, 50.0),
+    "v5litepod": (6.25, 50.0),
+    "v5p": (25.0, 50.0),
+    "v6e": (12.5, 50.0),
+    "cpu": (1.0, 100.0),
+}
+
 #: fallback HBM for families the planner table doesn't know (the "cpu"
 #: pseudo-family): enough to trace, small enough that a real model's
 #: HBM-OVERCOMMIT check still exercises on CI
@@ -75,9 +103,12 @@ _CPU_HBM_BYTES = 16 * 1024**3
 
 @dataclasses.dataclass(frozen=True)
 class Topology:
-    """One named slice: chip kind + count + interconnect figures."""
+    """One named deployment: chip kind + count + interconnect figures.
+    ``n_slices > 1`` is a multi-slice deployment (``"2xv5p-64"``):
+    ``n_devices`` is the TOTAL chip count across slices, ICI spans one
+    slice, slices talk over DCN at the dcn_* figures."""
 
-    name: str             # e.g. "v5p-64"
+    name: str             # e.g. "v5p-64" or "2xv5p-64"
     device_kind: str      # PJRT device_kind string, keys the HBM table
     n_devices: int
     ici_gbps: float       # aggregate ICI bandwidth per chip, GB/s
@@ -88,33 +119,64 @@ class Topology:
     #: utils/probe.py table (one source of truth), so a directly
     #: constructed Topology prices compute the same as parse_topology.
     peak_tflops: Optional[float] = None
+    #: multi-slice (DCN) tier. Defaults keep every existing
+    #: single-slice construction site valid: one slice, DCN figures
+    #: resolved from the device kind's family in __post_init__.
+    n_slices: int = 1
+    dcn_gbps: Optional[float] = None
+    dcn_hop_latency_us: Optional[float] = None
 
     def __post_init__(self) -> None:
         if self.peak_tflops is None:
             object.__setattr__(
                 self, "peak_tflops", _peak_tflops(self.device_kind))
+        if self.dcn_gbps is None or self.dcn_hop_latency_us is None:
+            fam = _KIND_TO_FAMILY.get(self.device_kind, "cpu")
+            gbps, lat = DCN_SPECS.get(fam, DCN_SPECS["cpu"])
+            if self.dcn_gbps is None:
+                object.__setattr__(self, "dcn_gbps", gbps)
+            if self.dcn_hop_latency_us is None:
+                object.__setattr__(self, "dcn_hop_latency_us", lat)
+        if self.n_slices < 1 or self.n_devices % self.n_slices:
+            raise ValueError(
+                f"topology {self.name!r}: {self.n_devices} devices do "
+                f"not split into {self.n_slices} equal slices")
 
     @property
     def hbm_gib(self) -> float:
         return self.hbm_bytes / 1024**3
 
+    @property
+    def devices_per_slice(self) -> int:
+        return self.n_devices // self.n_slices
+
     def describe(self) -> str:
-        return (f"{self.name}: {self.n_devices}x {self.device_kind} "
+        base = (f"{self.name}: {self.n_devices}x {self.device_kind} "
                 f"({self.hbm_gib:.0f} GiB HBM, {self.ici_gbps:.0f} GB/s "
                 "ICI per chip)")
+        if self.n_slices > 1:
+            base += (f" in {self.n_slices} slices of "
+                     f"{self.devices_per_slice} over DCN "
+                     f"({self.dcn_gbps:.1f} GB/s per chip)")
+        return base
 
 
 def parse_topology(name: str, *,
                    hbm_bytes: Optional[int] = None) -> Topology:
-    """``"v5p-64"`` -> a Topology. The family keys ICI_SPECS; the chip
-    count is the part after the dash. Unknown families raise listing the
-    known ones (same first-contact contract as hbm_bytes_for_kind)."""
-    m = re.fullmatch(r"([a-z0-9]+?)-(\d+)", name.strip().lower())
+    """``"v5p-64"`` -> a Topology; ``"2xv5p-64"`` -> TWO v5p-64 slices
+    joined over DCN (128 chips total, ``n_slices=2``). The family keys
+    ICI_SPECS; the chip count after the dash is PER SLICE. Unknown
+    families raise listing the known ones (same first-contact contract
+    as hbm_bytes_for_kind)."""
+    m = re.fullmatch(r"(?:(\d+)x)?([a-z][a-z0-9]*?)-(\d+)",
+                     name.strip().lower())
     if not m:
         raise ValueError(
             f"cannot parse topology {name!r}; expected <family>-<chips> "
-            f"like 'v5p-64' (families: {sorted(ICI_SPECS)})")
-    family, count = m.group(1), int(m.group(2))
+            "like 'v5p-64', or <slices>x<family>-<chips> like "
+            f"'2xv5p-64' (families: {sorted(ICI_SPECS)})")
+    slices = int(m.group(1) or 1)
+    family, count = m.group(2), int(m.group(3))
     if family not in ICI_SPECS:
         raise ValueError(
             f"unknown topology family {family!r} (known: "
@@ -122,15 +184,17 @@ def parse_topology(name: str, *,
             "topology_for_kind for other hardware")
     if count < 1:
         raise ValueError(f"topology {name!r} must have >= 1 chip")
+    if slices < 1:
+        raise ValueError(f"topology {name!r} must have >= 1 slice")
     kind, gbps, lat = ICI_SPECS[family]
     if hbm_bytes is None:
         try:
             hbm_bytes = hbm_bytes_for_kind(kind)
         except ValueError:  # the "cpu" pseudo-family
             hbm_bytes = _CPU_HBM_BYTES
-    return Topology(name=name, device_kind=kind, n_devices=count,
+    return Topology(name=name, device_kind=kind, n_devices=slices * count,
                     ici_gbps=gbps, ici_hop_latency_us=lat,
-                    hbm_bytes=int(hbm_bytes))
+                    hbm_bytes=int(hbm_bytes), n_slices=slices)
 
 
 def topology_for_kind(device_kind: str, n_devices: int, *,
@@ -181,7 +245,26 @@ def compute_time_us(flops: float, topo: Topology) -> float:
 @dataclasses.dataclass(frozen=True)
 class CollectiveCost:
     wire_bytes: int   # bytes each chip puts on ICI for this collective
-    time_us: float    # ring-model latency estimate
+    time_us: float    # ring-model latency estimate (both tiers, serial)
+    #: bytes each chip puts on DCN (0 on a single-slice group). When
+    #: nonzero, ``time_us`` already includes the DCN stage — the two
+    #: tiers are priced as sequential hierarchical stages.
+    dcn_bytes: int = 0
+    dcn_time_us: float = 0.0
+
+
+def _ring(kind: str, payload: float, n: int) -> Tuple[float, int]:
+    """(wire bytes per chip, ring hops) for one single-tier collective
+    over group size ``n`` — the standard ring algebra."""
+    if n <= 1:
+        return 0.0, 0
+    frac = (n - 1) / n
+    if kind in ("all_gather", "reduce_scatter", "all_to_all"):
+        return payload * frac, n - 1
+    if kind == "ppermute":
+        return float(payload), 1
+    # psum / pmax / pmin / pbroadcast and friends: all_reduce-shaped
+    return 2.0 * payload * frac, 2 * (n - 1)
 
 
 def collective_cost(
@@ -189,6 +272,8 @@ def collective_cost(
     payload_bytes: int,
     axis_sizes: Mapping[str, int],
     topo: Topology,
+    *,
+    dcn_group: int = 1,
 ) -> CollectiveCost:
     """Ring-model wire bytes + latency for ONE collective.
 
@@ -196,23 +281,51 @@ def collective_cost(
     operand bytes for psum/ppermute/all_to_all/reduce_scatter, and the
     per-chip FULL (post-gather) bytes for all_gather. ``axis_sizes`` maps
     the participating mesh axes to their sizes; the group size is their
-    product."""
+    product.
+
+    ``dcn_group`` is the number of DCN slices the group spans (1 =
+    intra-slice; use `parallel.plan.group_dcn_span` to derive it from
+    the mesh layout). A crossing group is priced as the hierarchical
+    two-level algorithm: the intra-slice stage over n/dcn_group members
+    rides ICI; the inter-slice stage rides DCN on the intra-reduced (or
+    intra-sharded) payload — each chip drives its own inter-slice ring
+    on a 1/n_intra share, the standard two-level all-reduce. Two
+    exceptions with NO intra-stage payload reduction: a crossing
+    ppermute puts its whole payload on DCN (one hop), and a crossing
+    all_to_all sends its chunks directly — the (s-1)/s fraction
+    targeting remote slices crosses DCN at full size."""
     n = max(1, math.prod(axis_sizes.values()))
     if n == 1:
         return CollectiveCost(0, 0.0)
-    frac = (n - 1) / n
-    if kind == "psum":
-        wire = 2.0 * payload_bytes * frac
-        hops = 2 * (n - 1)
-    elif kind in ("all_gather", "reduce_scatter", "all_to_all"):
-        wire = payload_bytes * frac
-        hops = n - 1
-    elif kind == "ppermute":
-        wire = float(payload_bytes)
-        hops = 1
-    else:  # pmax/pmin/pbroadcast and friends: all_reduce-shaped
-        wire = 2.0 * payload_bytes * frac
-        hops = 2 * (n - 1)
-    time_us = (wire / (topo.ici_gbps * 1e3)
-               + hops * topo.ici_hop_latency_us)
-    return CollectiveCost(int(wire), time_us)
+    s = max(1, min(int(dcn_group), n))
+    if n % s:
+        # a group that touches s slices unevenly degrades to the
+        # conservative read: price the whole group on DCN figures
+        s = n
+    n_intra = n // s
+    if kind == "ppermute" and s > 1:
+        dcn_wire, dcn_hops = float(payload_bytes), 1
+        ici_wire, ici_hops = 0.0, 0
+    elif kind == "all_to_all" and s > 1:
+        # all_to_all has NO intra-stage payload reduction (unlike the
+        # reduce/gather shapes below): each chip's payload splits into
+        # n equal chunks sent directly — n_intra-1 stay on ICI, the
+        # (s-1)/s fraction targeting remote slices crosses DCN whole
+        ici_wire = payload_bytes * (n_intra - 1) / n
+        ici_hops = max(0, n_intra - 1)
+        dcn_wire = payload_bytes * (s - 1) / s
+        dcn_hops = s - 1
+    else:
+        ici_wire, ici_hops = _ring(kind, payload_bytes, n_intra)
+        dcn_wire, dcn_hops = _ring(kind, payload_bytes / n_intra, s)
+    ici_time = (ici_wire / (topo.ici_gbps * 1e3)
+                + ici_hops * topo.ici_hop_latency_us)
+    dcn_time = 0.0
+    if s > 1:
+        dcn_time = (dcn_wire / (topo.dcn_gbps * 1e3)
+                    + dcn_hops * topo.dcn_hop_latency_us)
+    else:
+        dcn_wire = 0.0
+    return CollectiveCost(int(ici_wire), ici_time + dcn_time,
+                          dcn_bytes=int(dcn_wire),
+                          dcn_time_us=dcn_time)

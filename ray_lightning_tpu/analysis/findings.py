@@ -110,6 +110,15 @@ RULES: Dict[str, Rule] = {r.id: r for r in (
          "overlap knob (FSDP/ShardedMesh(overlap='on')) to hide it "
          "behind the previous layer's compute "
          "(docs/PERFORMANCE.md 'collective overlap')"),
+    Rule("RLT306", "dcn-crossing-shard-axis", "warning",
+         "a tensor/fsdp/seq/expert/pipe mesh axis spans DCN slices on a "
+         "multi-slice topology: its per-layer collectives (weight "
+         "gathers, tensor psums, ring permutes) would ride the slow "
+         "inter-slice network every step — an order-of-magnitude "
+         "performance cliff. Only the `data` axis belongs across "
+         "slices (hierarchical gradient reduction, docs/ELASTIC.md "
+         "'DCN cost model'); re-shape the mesh so the crossing axis "
+         "fits inside one slice"),
     Rule("RLT303", "ring-deadlock", "error",
          "a ppermute permutation is not a valid schedule (duplicate "
          "source/destination, out-of-range rank, a full permutation "
@@ -152,6 +161,17 @@ RULES: Dict[str, Rule] = {r.id: r for r in (
          "Keep device shapes fixed — decode into a position-indexed "
          "KV cache, pad prompts to buckets, or use the fixed-capacity "
          "slot engine (serve.DecodeEngine, docs/SERVING.md)"),
+    # RLT6xx — elasticity anti-patterns (docs/ELASTIC.md): code that
+    # pins a job to one world size for life.
+    Rule("RLT601", "pinned-world-size", "warning",
+         "batch/rank math hardcodes a device count (a `batch // 8` / "
+         "`world % 16` against an integer literal, or an ==/!= assert "
+         "pinning jax.device_count()/len(jax.devices()) to a specific "
+         "N): the code breaks the moment the elastic supervisor "
+         "reshards the job onto a different world size. Derive the "
+         "divisor from the mesh (parallel.mesh.batch_size_divisor, "
+         "plan.dp_degree, MeshSpec.resolve) and gate on capability "
+         "(> 1), not on a pinned count (docs/ELASTIC.md)"),
 )}
 
 

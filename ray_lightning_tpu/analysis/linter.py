@@ -1261,6 +1261,99 @@ class _ServeLoopLint:
         walk(tree.body, None, set())
 
 
+class _PinnedWorldLint:
+    """RLT601 pinned-world-size (docs/ELASTIC.md): code that computes
+    per-host batch or rank math from a HARDCODED device count instead
+    of the mesh/plan helpers breaks the moment the elastic supervisor
+    reshards the job onto a different world size. Two arms:
+
+      A ``jax.device_count() == 8`` / ``len(jax.devices()) != 4`` —
+        an ==/!= pin of a topology query against a literal >= 2
+        (capability checks ``== 1`` / ``> 1`` are fine and common);
+      B ``batch // 8`` / ``global_batch % 16`` / ``rank // 4`` — batch/
+        world/rank-named values floor-divided or modulo'd by a literal
+        power-of-two >= 2 (the device-count constants jobs get pinned
+        to). Deriving the divisor from the mesh
+        (``mesh.batch_size_divisor``, ``plan.dp_degree``) never fires:
+        those are names/calls, not literals.
+    """
+
+    #: terminal attribute names of the topology queries arm A watches
+    _COUNT_CALLS = ("device_count", "local_device_count",
+                    "process_count", "global_device_count")
+    _NAME_RE = re.compile(r"(?:^|_)(batch|bsz|world|rank)(?:_|$|size)",
+                          re.IGNORECASE)
+
+    def __init__(self, lint: _FileLint):
+        self.lint = lint
+
+    def run(self, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Compare):
+                self._compare(node)
+            elif (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, (ast.FloorDiv, ast.Mod))):
+                self._divmod(node)
+
+    def _is_count_call(self, node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        name = (_dotted(node.func) or "").split(".")[-1]
+        if name in self._COUNT_CALLS:
+            return True
+        if name == "len" and node.args:
+            inner = node.args[0]
+            if isinstance(inner, ast.Call):
+                iname = (_dotted(inner.func) or "").split(".")[-1]
+                return iname in ("devices", "local_devices")
+        return False
+
+    def _compare(self, node: ast.Compare) -> None:
+        sides = [node.left] + list(node.comparators)
+        for op, lhs, rhs in zip(node.ops, sides, sides[1:]):
+            if not isinstance(op, (ast.Eq, ast.NotEq)):
+                continue
+            for call, lit in ((lhs, rhs), (rhs, lhs)):
+                if (self._is_count_call(call)
+                        and isinstance(lit, ast.Constant)
+                        and isinstance(lit.value, int)
+                        and lit.value >= 2):
+                    self.lint.add(
+                        "RLT601",
+                        f"topology query pinned to a hardcoded world "
+                        f"size ({lit.value}): this code dies on any "
+                        "other topology, so the elastic supervisor can "
+                        "never reshard the job (docs/ELASTIC.md). Gate "
+                        "on capability (> 1) or derive the expectation "
+                        "from the mesh/plan (MeshSpec.resolve, "
+                        "plan.dp_degree)", node)
+                    return
+
+    def _divmod(self, node: ast.BinOp) -> None:
+        rhs = node.right
+        if not (isinstance(rhs, ast.Constant)
+                and isinstance(rhs.value, int)):
+            return
+        v = rhs.value
+        if v < 2 or (v & (v - 1)):  # literal power-of-two >= 2 only
+            return
+        name = _dotted(node.left)
+        if name is None and isinstance(node.left, ast.Subscript):
+            name = _dotted(node.left.value)
+        if name is None or not self._NAME_RE.search(
+                name.split(".")[-1]):
+            return
+        op = "//" if isinstance(node.op, ast.FloorDiv) else "%"
+        self.lint.add(
+            "RLT601",
+            f"per-host batch/rank math against a hardcoded device "
+            f"count ({name} {op} {v}): the divisor is pinned to one "
+            "world size, so an elastic reshard (or any other topology) "
+            "silently mis-shards. Derive it from the mesh "
+            "(parallel.mesh.batch_size_divisor(mesh), plan.dp_degree) "
+            "— docs/ELASTIC.md", node)
+
+
 def lint_source(source: str, filename: str = "<string>",
                 extra_axes: Sequence[str] = ()) -> List[Finding]:
     """Lint one file's source text. Never imports the target."""
@@ -1320,6 +1413,7 @@ def lint_source(source: str, filename: str = "<string>",
     _HotLoopLint(lint).run(tree, coll.funcs)
     _TelemetryCallbackLint(lint).run(tree)
     _ServeLoopLint(lint).run(tree, coll.funcs)
+    _PinnedWorldLint(lint).run(tree)
     return lint.findings
 
 

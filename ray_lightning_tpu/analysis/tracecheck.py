@@ -178,6 +178,11 @@ class CollectiveEvent:
     prefetchable: bool = False
     scope: Optional[int] = None
     hidden_us: float = 0.0
+    #: bytes each chip puts on DCN (multi-slice topologies only): the
+    #: inter-slice stage of a hierarchical collective whose group spans
+    #: slices. ``wire_bytes`` stays the ICI tier; ``time_us`` includes
+    #: both tiers (costmodel.collective_cost).
+    dcn_bytes: int = 0
 
     @property
     def exposed_us(self) -> float:
@@ -191,10 +196,12 @@ class CollectiveEvent:
         elif self.prefetchable and self.scope is not None:
             extra += " exposed"
         who = f"  <{self.param_path}>" if self.param_path else ""
+        dcn = (f" +{_fmt_bytes(self.dcn_bytes).strip()} DCN"
+               if self.dcn_bytes else "")
         return (f"{self.kind:<14} axes={','.join(self.axes) or '-'} "
-                f"x{self.count:<4} {_fmt_bytes(self.wire_bytes)} wire "
-                f"{self.time_us:9.1f} us  [{tag}{extra}] {self.source}"
-                f"{who}")
+                f"x{self.count:<4} {_fmt_bytes(self.wire_bytes)} wire"
+                f"{dcn} {self.time_us:9.1f} us  [{tag}{extra}] "
+                f"{self.source}{who}")
 
 
 def _fmt_bytes(n: float) -> str:
@@ -228,6 +235,12 @@ class TraceReport:
     @property
     def ici_bytes_per_step(self) -> int:
         return sum(e.wire_bytes for e in self.collectives)
+
+    @property
+    def dcn_bytes_per_step(self) -> int:
+        """Per-chip bytes on the inter-slice (DCN) tier; 0 on a
+        single-slice topology."""
+        return sum(e.dcn_bytes for e in self.collectives)
 
     @property
     def ici_time_us(self) -> float:
@@ -282,6 +295,13 @@ class TraceReport:
                 f"ICI total: {self.ici_bytes_per_step / gib:.3f} GiB/step "
                 f"on the wire, ~{self.ici_time_us / 1e3:.2f} ms serialized "
                 f"({self.topology.ici_gbps:.0f} GB/s per chip)")
+            if self.topology.n_slices > 1:
+                lines.append(
+                    f"DCN total: {self.dcn_bytes_per_step / gib:.3f} "
+                    f"GiB/step per chip across {self.topology.n_slices} "
+                    f"slices ({self.topology.dcn_gbps:.1f} GB/s per "
+                    "chip) — inter-slice stage of the crossing "
+                    "collectives, itemized above")
             ov = self.overlap or {}
             lines.append(
                 f"overlap: {'prefetch schedule detected' if ov.get('scheduled') else 'no prefetch schedule (overlap=off)'}"
@@ -322,9 +342,12 @@ class TraceReport:
                 "n_devices": self.topology.n_devices,
                 "ici_gbps": self.topology.ici_gbps,
                 "hbm_bytes": self.topology.hbm_bytes,
+                "n_slices": self.topology.n_slices,
+                "dcn_gbps": self.topology.dcn_gbps,
             },
             "mesh": self.mesh_axes,
             "ici_bytes_per_step": self.ici_bytes_per_step,
+            "dcn_bytes_per_step": self.dcn_bytes_per_step,
             "ici_time_us": round(self.ici_time_us, 1),
             "ici_hidden_us": round(self.ici_hidden_us, 1),
             "ici_exposed_us": round(self.ici_exposed_us, 1),
@@ -334,7 +357,7 @@ class TraceReport:
             "collectives": [
                 {"kind": e.kind, "axes": list(e.axes),
                  "payload_bytes": e.payload_bytes, "count": e.count,
-                 "wire_bytes": e.wire_bytes,
+                 "wire_bytes": e.wire_bytes, "dcn_bytes": e.dcn_bytes,
                  "time_us": round(e.time_us, 1), "implicit": e.implicit,
                  "source": e.source, "param_path": e.param_path,
                  "unbounded": e.unbounded,
@@ -438,7 +461,11 @@ class _StepAuditor:
     def __init__(self, mesh_sizes: Mapping[str, int], topo: Topology,
                  param_shapes: Mapping[Tuple, Tuple[Spec, str]]):
         self.sizes = {ax: s for ax, s in mesh_sizes.items() if s > 1}
+        #: FULL axis sizes (incl. trivial) — the slice-layout math needs
+        #: the whole mixed radix, not just the live axes
+        self.full_sizes = dict(mesh_sizes)
         self.topo = topo
+        self._dcn_span_cache: Dict[Tuple[str, ...], int] = {}
         #: shape -> (spec, path) for param/opt leaves AND their
         #: leading-dim-stripped (scan-stacked) suffixes: the ZeRO
         #: reduce_scatter matcher
@@ -478,6 +505,28 @@ class _StepAuditor:
             return 0
         return int(math.prod(shape) or 1) * dtype.itemsize // self._div(spec)
 
+    def _dcn_span(self, axes: Sequence[str]) -> int:
+        """Slices the collective group over ``axes`` spans on this
+        topology's slice-major layout (1 on single-slice). Also 1 when
+        the mesh does not cover the whole deployment (an n_devices
+        override smaller than the topology): a sub-deployment mesh
+        packs into the fewest slices, so charging cross-slice traffic
+        from a tiling the hardware never forces would fabricate DCN
+        bytes and RLT306 flags."""
+        if self.topo.n_slices <= 1:
+            return 1
+        if math.prod(self.full_sizes.values()) != self.topo.n_devices:
+            return 1
+        key = tuple(sorted(axes))
+        span = self._dcn_span_cache.get(key)
+        if span is None:
+            from ray_lightning_tpu.parallel.plan import group_dcn_span
+
+            span = group_dcn_span(key, self.full_sizes,
+                                  self.topo.n_slices)
+            self._dcn_span_cache[key] = span
+        return span
+
     def record(self, kind: str, payload: int, axes: Sequence[str],
                mult: int, *, implicit: bool, source: str,
                param_path: Optional[str] = None,
@@ -489,7 +538,8 @@ class _StepAuditor:
             return
         cost = collective_cost(kind if kind in (
             "psum", "all_gather", "reduce_scatter", "all_to_all",
-            "ppermute") else "psum", payload, group, self.topo)
+            "ppermute") else "psum", payload, group, self.topo,
+            dcn_group=self._dcn_span(axes))
         scope = self._scope_stack[-1] if self._scope_stack else None
         key = (kind, tuple(sorted(axes)), payload, source, implicit,
                bool(self._unbounded), scope, prefetchable)
@@ -501,11 +551,13 @@ class _StepAuditor:
                 time_us=cost.time_us * mult, implicit=implicit,
                 source=source, param_path=param_path,
                 unbounded=bool(self._unbounded),
-                prefetchable=prefetchable, scope=scope)
+                prefetchable=prefetchable, scope=scope,
+                dcn_bytes=cost.dcn_bytes * mult)
         else:
             ev.count += mult
             ev.wire_bytes += cost.wire_bytes * mult
             ev.time_us += cost.time_us * mult
+            ev.dcn_bytes += cost.dcn_bytes * mult
 
     def flag(self, rule: str, message: str, *, source: str,
              param_path: Optional[str] = None) -> None:
@@ -1756,6 +1808,32 @@ def audit_step(
                                scheduled=auditor.saw_prefetch_marker)
 
     findings = auditor.findings
+    if topo.n_slices > 1 and n_devices == topo.n_devices:
+        # multi-slice placement audit (docs/ELASTIC.md "DCN cost
+        # model"): with the slice-major layout the mesh layer builds
+        # (order_devices_for_slices), only the outermost `data` axis
+        # may span slices — its cross-slice traffic is the hierarchical
+        # gradient reduction, priced above. Any OTHER axis crossing the
+        # boundary puts per-layer collectives on DCN: flag it. A mesh
+        # SMALLER than the deployment (n_devices override) packs into
+        # the fewest slices and is never flagged (same guard as
+        # _dcn_span).
+        from ray_lightning_tpu.parallel.plan import dcn_crossing_axes
+
+        for ax, span in sorted(
+                dcn_crossing_axes(sizes, topo.n_slices).items()):
+            if ax == "data":
+                continue
+            findings.append(Finding(
+                "RLT306",
+                f"mesh axis '{ax}' (size {sizes.get(ax)}) spans {span} "
+                f"DCN slices on {topo.name}: its collectives ride the "
+                f"inter-slice network ({topo.dcn_gbps:.1f} GB/s per "
+                f"chip vs {topo.ici_gbps:.0f} GB/s ICI) every step — "
+                "place only `data` across slices and keep "
+                f"'{ax}' within a slice "
+                f"(<= {topo.devices_per_slice} devices)",
+                symbol=label or topo.name))
     if not auditor.saw_prefetch_marker:
         # RLT305 exposed-collective-in-scan: a per-trip ZeRO weight
         # gather inside a scanned body with no prefetch schedule.

@@ -3,10 +3,11 @@ from ray_lightning_tpu.checkpoint.io import (
     load_checkpoint,
     latest_checkpoint,
     restore_checkpoint,
+    sharding_provenance,
     verify_checkpoint,
     wait_for_checkpoints,
 )
 
 __all__ = ["save_checkpoint", "load_checkpoint", "latest_checkpoint",
-           "restore_checkpoint", "verify_checkpoint",
-           "wait_for_checkpoints"]
+           "restore_checkpoint", "sharding_provenance",
+           "verify_checkpoint", "wait_for_checkpoints"]

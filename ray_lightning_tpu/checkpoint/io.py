@@ -269,6 +269,69 @@ def _write_meta(path: str, meta: Dict[str, Any]) -> None:
     os.replace(tmp, meta_path)
 
 
+def sharding_provenance(mesh, state: Any) -> Dict[str, Any]:
+    """The topology-provenance stamps for a checkpoint's meta
+    (docs/ELASTIC.md "resharding restore"): which mesh wrote it and how
+    each leaf was laid out, so a cross-topology restore
+    (`elastic.reshard.reshard_restore`) can VALIDATE the move instead
+    of trusting the caller.
+
+      mesh_spec     {axis: size} of the writing mesh (all axes)
+      topology      n_devices / process_count / platform at write time
+      param_specs   {tree path: per-dim spec} for every leaf of
+                    ``state["params"]`` whose sharding is known — the
+                    JSON form of its PartitionSpec (None = unsharded
+                    dim, a list = the axis names on that dim)
+
+    Opt-state specs are not recorded: they inherit their param's layout
+    by construction (Strategy.opt_state_shardings), so the param table
+    is the whole story. Tolerant of missing pieces (a host-numpy tree
+    has no shardings) — absent stamps simply mean legacy semantics."""
+    out: Dict[str, Any] = {}
+    if mesh is None:
+        return out
+    try:
+        shape = dict(mesh.shape)
+    except Exception:  # noqa: BLE001 — provenance is best-effort
+        return out
+    out["mesh_spec"] = {str(k): int(v) for k, v in shape.items()}
+    try:
+        platform = mesh.devices.flat[0].platform
+    except Exception:  # noqa: BLE001 — AbstractMesh has no devices
+        platform = None
+    n_devices = 1
+    for v in out["mesh_spec"].values():
+        n_devices *= v
+    out["topology"] = {
+        "n_devices": n_devices,
+        "process_count": jax.process_count(),
+        "platform": platform,
+    }
+    params = (state or {}).get("params") if isinstance(state, dict) \
+        else None
+    if params is not None:
+        from ray_lightning_tpu.utils.pytree import named_leaves
+
+        specs: Dict[str, Any] = {}
+        try:
+            for path, leaf in named_leaves(params):
+                spec = getattr(getattr(leaf, "sharding", None), "spec",
+                               None)
+                if spec is None:
+                    continue
+                specs[path] = [
+                    None if d is None
+                    else list(d) if isinstance(d, (tuple, list))
+                    else str(d)
+                    for d in tuple(spec)
+                ]
+        except Exception:  # noqa: BLE001 — best-effort
+            specs = {}
+        if specs:
+            out["param_specs"] = specs
+    return out
+
+
 def verify_checkpoint(path: str) -> Tuple[bool, str]:
     """Is this directory a complete, uncorrupted checkpoint?
     Returns (ok, reason) — reason names the first failed check."""
@@ -296,6 +359,43 @@ def verify_checkpoint(path: str) -> Tuple[bool, str]:
                            f"{meta.get('ckpt_files')} recorded")
         if digest != recorded:
             return False, "digest mismatch (corrupt or tampered state)"
+    ok, reason = _verify_provenance(meta)
+    if not ok:
+        return False, reason
+    return True, "ok"
+
+
+def _verify_provenance(meta: Dict[str, Any]) -> Tuple[bool, str]:
+    """Internal consistency of the sharding-provenance stamps (when
+    present — legacy checkpoints without them verify fine): the mesh
+    axis product must equal the recorded device count, and every axis a
+    param spec names must exist in the writing mesh. A checkpoint whose
+    own provenance is self-contradictory would make a resharding
+    restore validate against fiction."""
+    mesh_spec = meta.get("mesh_spec")
+    if mesh_spec is None:
+        return True, "ok"
+    if not isinstance(mesh_spec, dict) or not all(
+            isinstance(v, int) and v >= 1 for v in mesh_spec.values()):
+        return False, "malformed mesh_spec provenance (non-integer axes)"
+    n = 1
+    for v in mesh_spec.values():
+        n *= v
+    topo = meta.get("topology") or {}
+    rec_n = topo.get("n_devices")
+    if rec_n is not None and int(rec_n) != n:
+        return False, (f"provenance mismatch: mesh_spec covers {n} "
+                       f"devices but topology records {rec_n}")
+    for p, spec in (meta.get("param_specs") or {}).items():
+        for dim in spec or ():
+            names = dim if isinstance(dim, list) else \
+                [dim] if dim is not None else []
+            for name in names:
+                if name not in mesh_spec:
+                    return False, (
+                        f"provenance mismatch: param_specs[{p!r}] names "
+                        f"mesh axis {name!r} absent from mesh_spec "
+                        f"{sorted(mesh_spec)}")
     return True, "ok"
 
 

@@ -67,6 +67,7 @@ from ray_lightning_tpu.telemetry.spans import (
     PH_DISPATCH,
     PH_EVAL,
     PH_METRICS,
+    PH_RESHARD,
     PH_STEP,
     TelemetryRecorder,
 )
@@ -561,6 +562,16 @@ class Trainer:
             "opt_state": self.state.opt_state,
             "step": self.state.step,
         }
+        # topology provenance (docs/ELASTIC.md): stamp the writing mesh
+        # and per-leaf layouts so a cross-topology restore
+        # (elastic.reshard) can validate the move; checkpoints without
+        # these stamps restore with NO cross-mesh validation (the
+        # writing mesh is unknowable) and the elastic supervisor
+        # refuses to resize onto them
+        from ray_lightning_tpu.checkpoint.io import sharding_provenance
+
+        ckpt_meta.update(
+            sharding_provenance(self.strategy.mesh, checkpoint))
         self.module.on_save_checkpoint(checkpoint)
         self._invoke("on_save_checkpoint", checkpoint)
         # the span measures exactly what the TRAINING thread paid: the
@@ -656,12 +667,24 @@ class Trainer:
         )
         state = TrainState(step=step0, params=params, opt_state=opt_state)
         if ckpt_path:
-            restored = restore_checkpoint(
-                ckpt_path,
-                {"params": state.params, "opt_state": state.opt_state,
-                 "step": state.step},
-            )
             meta = read_meta(ckpt_path)
+            target = {"params": state.params,
+                      "opt_state": state.opt_state, "step": state.step}
+            move = self._reshard_move(meta)
+            if move is not None:
+                # cross-topology restore (docs/ELASTIC.md): the
+                # checkpoint was written on a DIFFERENT mesh — validate
+                # the move against its provenance and account the load
+                # as a `reshard` span (goodput bucket reshard_s), so an
+                # elastic shrink/grow is visible in `report`
+                log.warning(
+                    "resharding restore: checkpoint %s written on mesh "
+                    "%s, restoring onto %s", ckpt_path,
+                    move["from_mesh"], move["to_mesh"])
+                with self.telemetry_recorder.span(PH_RESHARD, meta=move):
+                    restored = restore_checkpoint(ckpt_path, target)
+            else:
+                restored = restore_checkpoint(ckpt_path, target)
             saved_epoch = int(meta.get("epoch", -1))
             if meta.get("mid_epoch", False):
                 # checkpoint taken inside a partially-trained epoch:
@@ -691,6 +714,41 @@ class Trainer:
             state = state.replace(guard=jax.device_put(
                 init_guard_state(), self.strategy.replicated()))
         return state
+
+    def _reshard_move(self, meta: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """When the checkpoint's recorded writing mesh differs from the
+        strategy's current mesh, validate the cross-topology move
+        (elastic.reshard) and return its summary; None for a same-mesh
+        restore. A provenance-carrying checkpoint whose move is ILLEGAL
+        raises ReshardError here — at setup, with the leaf and axis
+        named — instead of surfacing as a silent mislayout or an orbax
+        shape error mid-restore.
+
+        A LEGACY checkpoint (no ``mesh_spec`` stamp) also returns None:
+        its writing mesh is unknowable, so a cross-mesh resume can
+        neither be detected nor validated — the storage layer places
+        the global arrays onto whatever layout this run built, and a
+        warning marks the blind spot. The elastic supervisor refuses to
+        RESIZE onto such a checkpoint outright (`_begin_reshard`)."""
+        src = meta.get("mesh_spec")
+        if not src or self.strategy.mesh is None:
+            if meta and src is None and self.strategy.mesh is not None:
+                log.warning(
+                    "checkpoint carries no sharding provenance (written "
+                    "before elastic/): restoring WITHOUT cross-mesh "
+                    "validation — if the writing mesh differed from %s "
+                    "this restore reshards silently; re-save once to "
+                    "stamp provenance (docs/ELASTIC.md)",
+                    dict(self.strategy.mesh.shape))
+            return None
+        cur = {str(k): int(v) for k, v in self.strategy.mesh.shape.items()}
+        src = {str(k): int(v) for k, v in src.items()}
+        if {k: v for k, v in src.items() if v > 1} == \
+                {k: v for k, v in cur.items() if v > 1}:
+            return None
+        from ray_lightning_tpu.elastic.reshard import validate_reshard
+
+        return validate_reshard(meta, cur)
 
     def _apply_rollback_skip(self) -> None:
         """After a trainguard rollback (resume_skip_past set by the

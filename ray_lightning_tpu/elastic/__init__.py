@@ -1,0 +1,31 @@
+"""elastic/ — cross-topology checkpoint resharding, elastic supervision
+budgets, and DCN-aware multi-slice planning (docs/ELASTIC.md).
+
+Three legs sharing one topology-change vocabulary:
+
+  reshard   `reshard_restore` — restore any provenance-stamped
+            checkpoint onto any target sharding (mesh-to-mesh moves,
+            world-size changes); the Trainer stamps provenance into
+            every checkpoint and validates cross-mesh restores.
+  budget    `ElasticBudget` — the supervisor's world-size ladder:
+            legal survivor sizes (divisibility via the plan checker),
+            shrink on lost capacity instead of dying, grow back when
+            capacity returns, honest batch replanning.
+  DCN       the second network tier lives in analysis/costmodel.py
+            (`parse_topology("2xv5p-64")`) and tracecheck itemizes
+            ICI vs DCN bytes per step; RLT306 flags shard axes that
+            would cross slices.
+"""
+from ray_lightning_tpu.elastic.budget import ElasticBudget
+from ray_lightning_tpu.elastic.reshard import (
+    ReshardError,
+    checkpoint_provenance,
+    reshard_arrays,
+    reshard_restore,
+    validate_reshard,
+)
+
+__all__ = [
+    "ElasticBudget", "ReshardError", "checkpoint_provenance",
+    "reshard_arrays", "reshard_restore", "validate_reshard",
+]

@@ -1,0 +1,284 @@
+"""``python -m ray_lightning_tpu elastic`` — the elastic-training
+smoke gate (docs/ELASTIC.md), runnable on a box with no accelerator.
+
+``--smoke`` (the format.sh gate) runs two CPU-SPMD legs:
+
+  reshard   an 8-device fsdp=8 run saves a provenance-stamped
+            checkpoint; it is restored onto a 4-device fsdp=4 mesh and
+            every param/opt-state leaf must be BITWISE-equal to the
+            source checkpoint; a fresh trainer then resumes training
+            from it on the smaller mesh (the cross-topology restore is
+            the trainer's own `_reshard_move` path, recorded as a
+            `reshard` span).
+  shrink    a 2-process supervised run with an injected worker kill
+            and a retry policy that refuses any same-size relaunch
+            (max_restarts=0) must consult its ElasticBudget, reshard
+            the latest valid checkpoint onto the survivor world
+            (2 -> 1), resume, and converge — with the world change in
+            `SupervisedResult.reshards` and the `reshard_s` goodput
+            bucket present in the report.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# ---- smoke factories: module-level so cloudpickle ships them by
+# reference and workers import this module ----
+
+_SMOKE_CLASSES = 4
+_SMOKE_ROWS = 256
+_SMOKE_BATCH = 16
+
+
+def _smoke_module():
+    from ray_lightning_tpu.models.mlp import MLPClassifier
+
+    return MLPClassifier(features=(32,), num_classes=_SMOKE_CLASSES,
+                         lr=5e-2)
+
+
+def _smoke_trainer():
+    from ray_lightning_tpu import Trainer
+    from ray_lightning_tpu.parallel.strategy import FSDP
+
+    return Trainer(
+        # FSDP (not DP) on purpose: the world change then moves REAL
+        # shards, not replicated copies — min_shard_size lowered so the
+        # smoke MLP's small leaves actually shard
+        strategy=FSDP(min_shard_size=8),
+        max_epochs=2,
+        enable_progress_bar=False,
+        enable_checkpointing=False,  # the supervisor adds its own cadence
+        seed=0,
+        log_every_n_steps=1,
+    )
+
+
+def _smoke_data():
+    import jax
+    import numpy as np
+
+    from ray_lightning_tpu import DataLoader
+
+    rng = np.random.default_rng(0)
+    centers = rng.normal(size=(_SMOKE_CLASSES, 8)) * 3
+    y = rng.integers(0, _SMOKE_CLASSES, size=_SMOKE_ROWS)
+    x = (centers[y] + rng.normal(size=(_SMOKE_ROWS, 8)) * 0.1).astype(
+        np.float32)
+    shard = dict(num_shards=jax.process_count(),
+                 shard_index=jax.process_index())
+    train = DataLoader({"x": x, "y": y}, batch_size=_SMOKE_BATCH,
+                       shuffle=True, **shard)
+    val = DataLoader({"x": x, "y": y}, batch_size=_SMOKE_BATCH, **shard)
+    return train, val
+
+
+def _reshard_leg_remote():
+    """Runs as ONE worker with 8 virtual CPU devices: train on fsdp=8,
+    checkpoint, reshard-restore onto fsdp=4 bitwise, then resume
+    training on the 4-device mesh through the Trainer's own
+    cross-topology restore path."""
+    import os
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from ray_lightning_tpu import Trainer
+    from ray_lightning_tpu.checkpoint.io import load_checkpoint, read_meta
+    from ray_lightning_tpu.elastic.reshard import reshard_restore
+    from ray_lightning_tpu.parallel.strategy import FSDP
+
+    out: dict = {"ok": False}
+    base = tempfile.mkdtemp(prefix="rlt_elastic_smoke_")
+    ck = os.path.join(base, "ck")
+
+    module = _smoke_module()
+    trainer = _smoke_trainer()
+    train, val = _smoke_data()
+    trainer.fit(module, train, val)
+    trainer.save_checkpoint(ck)
+    src_world = len(jax.devices())
+    out["src_world"] = src_world
+    out["provenance"] = sorted(
+        k for k in read_meta(ck) if k in ("mesh_spec", "topology",
+                                          "param_specs"))
+
+    # bitwise leg: restore onto a FRESH 4-device fsdp=4 mesh and
+    # compare leaf-for-leaf against the source checkpoint's contents
+    s4 = FSDP(num_workers=4, min_shard_size=8)
+    s4.setup()
+    src = load_checkpoint(ck)  # host gather of the written bytes
+    import jax.numpy as jnp
+
+    tgt_params = s4.shard_params(
+        jax.tree.map(jnp.zeros_like, src["params"]))
+    tgt_opt = jax.tree.map(
+        jnp.zeros_like, src["opt_state"])
+    tgt_opt = jax.device_put(
+        tgt_opt, s4.opt_state_shardings(
+            jax.eval_shape(lambda t: t, tgt_opt), tgt_params))
+    target = {"params": tgt_params, "opt_state": tgt_opt,
+              "step": jax.device_put(jnp.zeros((), jnp.int32),
+                                     s4.replicated())}
+    restored = reshard_restore(ck, target)
+    mismatches = 0
+    leaves = 0
+    for a, b in zip(jax.tree.leaves(
+            {"params": src["params"], "opt_state": src["opt_state"]}),
+            jax.tree.leaves({"params": restored["params"],
+                             "opt_state": restored["opt_state"]})):
+        leaves += 1
+        if not np.array_equal(np.asarray(a),
+                              np.asarray(jax.device_get(b))):
+            mismatches += 1
+    out["leaves"] = leaves
+    out["bitwise_equal"] = mismatches == 0
+    out["restored_world"] = int(
+        jax.tree.leaves(restored["params"])[0].sharding.mesh.size)
+
+    # continue-training leg: a fresh trainer on the 4-device mesh
+    # resumes FROM the 8-device checkpoint (the Trainer's _reshard_move
+    # validates + spans the move) and still converges
+    module2 = _smoke_module()
+    trainer2 = Trainer(strategy=FSDP(num_workers=4, min_shard_size=8),
+                       max_epochs=3, enable_progress_bar=False,
+                       enable_checkpointing=False, seed=0,
+                       log_every_n_steps=1)
+    train2, val2 = _smoke_data()
+    metrics = trainer2.fit(module2, train2, val2, ckpt_path=ck)
+    acc = metrics.get("ptl/val_accuracy")
+    out["continued_val_accuracy"] = (float(acc) if acc is not None
+                                     else None)
+    out["continued"] = acc is not None and float(acc) > 0.8
+    out["ok"] = bool(out["bitwise_equal"] and out["continued"]
+                     and len(out["provenance"]) == 3)
+    return out
+
+
+def add_elastic_parser(sub) -> None:
+    p = sub.add_parser(
+        "elastic",
+        help="elastic-training smoke gate: cross-topology reshard "
+             "restore (bitwise) + supervised shrink-on-preemption "
+             "(docs/ELASTIC.md)")
+    p.add_argument("--smoke", action="store_true",
+                   help="run the format.sh gate: the 8->4 device "
+                        "reshard-bitwise leg and the world 2->1 "
+                        "supervised shrink leg, all on CPU")
+    p.add_argument("--processes", type=int, default=2,
+                   help="shrink leg's launch world size")
+    p.add_argument("--timeout", type=float, default=600.0)
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   default=argparse.SUPPRESS)
+
+
+def _shrink_leg(args, base_dir: str) -> dict:
+    import os
+
+    from ray_lightning_tpu.elastic.budget import ElasticBudget
+    from ray_lightning_tpu.resilience.policy import RetryPolicy
+    from ray_lightning_tpu.resilience.supervisor import (
+        ResilienceConfig,
+        SupervisedFailure,
+        fit_supervised,
+    )
+
+    cfg = ResilienceConfig(
+        checkpoint_dir=os.path.join(base_dir, "shrink"),
+        # max_restarts=0: the same-size relaunch is REFUSED, so the
+        # kill can only be survived by the elastic shrink — exactly
+        # the "preemption budget exhausted" acceptance scenario
+        policy=RetryPolicy(max_restarts=0, backoff_base_s=0.2,
+                           jitter=0.0),
+        save_every_n_steps=1,
+        stall_timeout_s=0.0,
+        heartbeat_interval_s=1.0,
+        elastic=ElasticBudget(min_world=1, max_reshards=2),
+        faults=f"kill:rank={min(1, args.processes - 1)},step=3",
+    )
+    leg: dict = {"ok": False}
+    try:
+        supervised = fit_supervised(
+            _smoke_module, _smoke_trainer, _smoke_data, args.processes,
+            resilience=cfg, platform="cpu",
+            num_cpu_devices_per_process=1, return_weights=False,
+            timeout=args.timeout)
+    except SupervisedFailure as exc:
+        leg["error"] = str(exc)
+        return leg
+    acc = supervised.result.metrics.get("ptl/val_accuracy")
+    buckets = ((supervised.goodput or {}).get("buckets") or {})
+    leg.update({
+        "reshards": supervised.reshards,
+        "final_world": supervised.final_world,
+        "val_accuracy": float(acc) if acc is not None else None,
+        "reshard_bucket_present": "reshard_s" in buckets,
+        "reshard_s": buckets.get("reshard_s"),
+    })
+    shrunk = (len(supervised.reshards) >= 1
+              and supervised.final_world == 1
+              and supervised.reshards[0]["reason"] == "shrink")
+    converged = acc is not None and float(acc) > 0.8
+    leg["ok"] = bool(shrunk and converged
+                     and leg["reshard_bucket_present"])
+    if not leg["ok"]:
+        leg["error"] = (
+            f"shrink leg failed: reshards={supervised.reshards}, "
+            f"final_world={supervised.final_world}, acc={acc}, "
+            f"reshard_bucket={leg['reshard_bucket_present']}")
+    return leg
+
+
+def run_elastic(args) -> int:
+    import tempfile
+
+    if not args.smoke:
+        print("error: only --smoke is implemented; see docs/ELASTIC.md "
+              "for the library API (elastic.reshard_restore, "
+              "ResilienceConfig(elastic=ElasticBudget(...)))",
+              file=sys.stderr)
+        return 2
+    from ray_lightning_tpu.runtime.launch import launch
+
+    out: dict = {}
+    base = args.checkpoint_dir or tempfile.mkdtemp(
+        prefix="rlt_elastic_smoke_")
+    out["checkpoint_dir"] = base
+
+    # leg 1: reshard-bitwise, one worker process with 8 CPU devices
+    try:
+        results = launch(_reshard_leg_remote, 1, platform="cpu",
+                         num_cpu_devices_per_process=8,
+                         timeout=args.timeout)
+        out["reshard"] = results[0]
+    except Exception as exc:  # noqa: BLE001 — the gate must report,
+        # not traceback
+        out["reshard"] = {"ok": False,
+                          "error": f"{type(exc).__name__}: {exc}"}
+
+    # leg 2: supervised shrink 2 -> 1
+    out["shrink"] = _shrink_leg(args, base)
+
+    out["ok"] = bool(out["reshard"].get("ok") and out["shrink"].get("ok"))
+    if getattr(args, "as_json", False):
+        print(json.dumps(out))
+    else:
+        r, s = out["reshard"], out["shrink"]
+        print(f"elastic {'ok' if out['ok'] else 'FAILED'}:")
+        print(f"  reshard: {'ok' if r.get('ok') else 'FAILED'} "
+              f"bitwise_equal={r.get('bitwise_equal')} "
+              f"leaves={r.get('leaves')} "
+              f"continued_acc={r.get('continued_val_accuracy')}")
+        print(f"  shrink:  {'ok' if s.get('ok') else 'FAILED'} "
+              f"reshards={[(e['from_world'], e['to_world']) for e in s.get('reshards') or []]} "
+              f"acc={s.get('val_accuracy')} "
+              f"reshard_bucket={s.get('reshard_bucket_present')}")
+        for leg in ("reshard", "shrink"):
+            if out[leg].get("error"):
+                print(f"  {leg} error: {out[leg]['error']}",
+                      file=sys.stderr)
+    return 0 if out["ok"] else 1

@@ -1,0 +1,195 @@
+"""Resharding restore: load any checkpoint onto any target sharding.
+
+Every checkpoint used to be pinned to the mesh that wrote it — the
+restore path rebuilt the writer's exact layout, so a k-host preemption
+(or a deliberate re-plan) orphaned the run's whole history. This module
+breaks the pin (docs/ELASTIC.md "resharding restore"):
+
+  * checkpoints carry **topology provenance** (checkpoint/io.py
+    `sharding_provenance`, stamped by the Trainer into every meta.json):
+    the writing mesh's axis sizes, device/process counts, and each param
+    leaf's PartitionSpec;
+  * `reshard_restore(path, target)` restores the checkpoint onto the
+    TARGET tree's shardings — an arbitrary mesh-to-mesh move (fsdp=8 ->
+    fsdp=4, a dp<->fsdp swap, a world-size change), validated against
+    the provenance first so an illegal or accidental move fails with
+    the axis named instead of a silent mislayout. Opt-state and any
+    extra slots (trainguard EMA state) ride the same move: the target
+    tree's layout is the contract, leaf for leaf.
+
+The move itself generalizes the `match_partition_rules` pattern (rules
+-> specs -> per-leaf placement) to arbitrary mesh-to-mesh transitions:
+the target specs come from the target strategy's own composition logic
+(the same code a fresh run would use), and the storage layer (orbax
+holds GLOBAL arrays; each host reads the shards its target layout
+needs) performs the actual movement — no gather-to-host round-trip, so
+an 8B-param resume onto a survivor mesh streams only what each host
+keeps.
+
+Back-compat: a checkpoint WITHOUT provenance (written before this
+subsystem) has an unknowable writing mesh, so no cross-mesh move can
+be validated against it: `reshard_restore` (and the supervisor's
+elastic resize) refuse it with a ReshardError naming the gap, and the
+legacy path (`checkpoint.restore_checkpoint`) restores it with no
+cross-mesh validation — the Trainer logs that blind spot.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Optional
+
+from ray_lightning_tpu.utils import get_logger
+
+log = get_logger(__name__)
+
+__all__ = ["ReshardError", "checkpoint_provenance", "validate_reshard",
+           "reshard_restore", "reshard_arrays"]
+
+
+class ReshardError(RuntimeError):
+    """A cross-topology restore that cannot (or must not) proceed:
+    missing provenance, contradictory provenance, or a malformed
+    target mesh."""
+
+
+def checkpoint_provenance(path: str) -> Dict[str, Any]:
+    """The topology-provenance stamps of a checkpoint's meta
+    (``mesh_spec`` / ``topology`` / ``param_specs``); empty dict for a
+    legacy checkpoint that carries none."""
+    from ray_lightning_tpu.checkpoint.io import read_meta
+
+    meta = read_meta(path)
+    return {k: meta[k] for k in ("mesh_spec", "topology", "param_specs")
+            if k in meta}
+
+
+def _live(sizes: Mapping[str, Any]) -> Dict[str, int]:
+    return {str(k): int(v) for k, v in sizes.items() if int(v) > 1}
+
+
+def validate_reshard(meta: Mapping[str, Any],
+                     target_mesh: Mapping[str, int]) -> Dict[str, Any]:
+    """Validate a move from the checkpoint described by ``meta`` onto a
+    mesh with ``target_mesh`` axis sizes. Returns the move summary
+
+        {"from_mesh", "to_mesh", "from_world", "to_world",
+         "changed_axes", "world_change"}
+
+    Raises ReshardError when the checkpoint has no provenance (legacy:
+    identical-sharding restore only), when its provenance is
+    self-contradictory, or when the target mesh is malformed. The
+    SHAPE-level agreement (every leaf's global shape unchanged) is
+    enforced by the storage layer during the actual restore — global
+    shapes are mesh-independent, so a mesh-level-legal move can only
+    fail there if the model itself changed."""
+    mesh_spec = meta.get("mesh_spec")
+    if not mesh_spec:
+        raise ReshardError(
+            "checkpoint carries no sharding provenance (no mesh_spec in "
+            "meta.json — written before elastic/ existed?): a move from "
+            "an unknowable writing mesh cannot be validated. Restore it "
+            "legacy-style via checkpoint.restore_checkpoint (no "
+            "cross-mesh validation), or re-save it once on the current "
+            "mesh to stamp provenance, then reshard")
+    src = _live(mesh_spec)
+    try:
+        dst = _live(target_mesh)
+    except (TypeError, ValueError) as exc:
+        raise ReshardError(
+            f"malformed target mesh {target_mesh!r}: {exc}") from exc
+    if any(int(v) < 1 for v in dict(target_mesh).values()):
+        raise ReshardError(
+            f"malformed target mesh {target_mesh!r}: axis sizes must "
+            "be >= 1")
+    # provenance self-consistency (the same checks verify_checkpoint
+    # runs): a contradictory stamp would make this validation fiction
+    from ray_lightning_tpu.checkpoint.io import _verify_provenance
+
+    ok, reason = _verify_provenance(dict(meta))
+    if not ok:
+        raise ReshardError(f"checkpoint provenance is invalid: {reason}")
+    from_world = 1
+    for v in src.values():
+        from_world *= v
+    to_world = 1
+    for v in dst.values():
+        to_world *= v
+    changed = sorted(set(src) ^ set(dst)
+                     | {ax for ax in set(src) & set(dst)
+                        if src[ax] != dst[ax]})
+    return {
+        "from_mesh": src,
+        "to_mesh": dst,
+        "from_world": from_world,
+        "to_world": to_world,
+        "changed_axes": changed,
+        "world_change": to_world != from_world,
+    }
+
+
+def _target_mesh_sizes(target: Any) -> Optional[Dict[str, int]]:
+    """Axis sizes of the first mesh found on the target tree's
+    shardings (None when the tree carries no NamedSharding — e.g. a
+    host-numpy tree, which is load_checkpoint territory)."""
+    import jax
+
+    for leaf in jax.tree.leaves(target):
+        sharding = getattr(leaf, "sharding", None)
+        mesh = getattr(sharding, "mesh", None)
+        shape = getattr(mesh, "shape", None)
+        if shape:
+            return {str(k): int(v) for k, v in dict(shape).items()}
+    return None
+
+
+def reshard_restore(path: str, target: Any, *,
+                    verify: bool = True) -> Any:
+    """Restore the checkpoint at ``path`` onto ``target``'s shardings —
+    an arbitrary mesh-to-mesh move. ``target`` is a pytree of jax.Arrays
+    or ShapeDtypeStructs whose ``.sharding`` gives the layout to restore
+    into (the same contract as `checkpoint.restore_checkpoint`); every
+    leaf present in the target — params, opt-state, guard/EMA slots —
+    reshards to its target layout.
+
+    The move is validated against the checkpoint's provenance first
+    (`validate_reshard`); ``verify=True`` additionally runs the
+    completeness/digest check so a torn or corrupt checkpoint is never
+    the source of a topology change. Returns the restored tree (runtime-
+    owned buffers — safe to donate, like restore_checkpoint)."""
+    import os
+
+    from ray_lightning_tpu.checkpoint.io import (
+        read_meta,
+        restore_checkpoint,
+        verify_checkpoint,
+    )
+
+    path = os.path.abspath(path)
+    if verify:
+        ok, reason = verify_checkpoint(path)
+        if not ok:
+            raise ReshardError(
+                f"refusing to reshard from invalid checkpoint {path}: "
+                f"{reason}")
+    sizes = _target_mesh_sizes(target)
+    if sizes is None:
+        raise ReshardError(
+            "target tree carries no NamedSharding — reshard_restore "
+            "needs the target layout (build the tree under the target "
+            "strategy, or use checkpoint.load_checkpoint for a host "
+            "gather)")
+    move = validate_reshard(read_meta(path), sizes)
+    log.info("resharding %s: %s -> %s (world %d -> %d, axes %s)",
+             path, move["from_mesh"], move["to_mesh"],
+             move["from_world"], move["to_world"],
+             ",".join(move["changed_axes"]) or "unchanged")
+    return restore_checkpoint(path, target)
+
+
+def reshard_arrays(tree: Any, shardings: Any) -> Any:
+    """In-memory mesh-to-mesh move: place an already-loaded tree onto
+    new shardings (same-process convenience; the checkpoint path is
+    `reshard_restore`). Works across meshes — XLA reshards through
+    host/ICI as needed."""
+    import jax
+
+    return jax.device_put(tree, shardings)

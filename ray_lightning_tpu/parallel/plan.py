@@ -31,7 +31,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Mapping, Optional, Sequence
 
 import jax
 import numpy as np
@@ -407,6 +407,66 @@ def find_max_local_batch(
     best = dataclasses.replace(
         base, activation_bytes_per_device=int(activation_bytes_fn(lo)))
     return lo, best
+
+
+# ---- multi-slice (DCN) layout queries -------------------------------------
+#
+# The mesh layer lays devices out slice-major with `data` outermost
+# (mesh.order_devices_for_slices): on an S-slice deployment, slice k
+# owns the k-th contiguous block of T/S linear device indices of the
+# AXIS_ORDER-major mesh array. These helpers answer, from that layout
+# contract alone (no devices), which communication groups cross the
+# slice boundary — the seam tracecheck's DCN tier (RLT306) and the
+# elastic planner both price against.
+
+
+def group_dcn_span(axes: Sequence[str], mesh_sizes: Mapping[str, int],
+                   n_slices: int) -> int:
+    """Number of distinct DCN slices a collective group varying exactly
+    ``axes`` touches (1 = the group lives inside one slice).
+
+    Computed from the mixed-radix AXIS_ORDER-major layout with
+    slice-major device order: enumerate the group's member coordinates
+    (axes absent from ``mesh_sizes`` count as size 1) and count the
+    distinct ``linear_index // devices_per_slice`` blocks. Exact for the
+    base-0 representative group; the layout is regular, so every other
+    group of the same axes has the same span."""
+    sizes = {ax: int(mesh_sizes.get(ax, 1)) for ax in AXIS_ORDER}
+    total = math.prod(sizes.values())
+    if n_slices <= 1 or total % n_slices:
+        return 1
+    per_slice = total // n_slices
+    strides: Dict[str, int] = {}
+    st = 1
+    for ax in reversed(AXIS_ORDER):
+        strides[ax] = st
+        st *= sizes[ax]
+    group_axes = [ax for ax in AXIS_ORDER
+                  if ax in tuple(axes) and sizes[ax] > 1]
+    members = {0}
+    for ax in group_axes:
+        members = {
+            base + k * strides[ax]
+            for base in members for k in range(sizes[ax])
+        }
+    return len({idx // per_slice for idx in members})
+
+
+def dcn_crossing_axes(mesh_sizes: Mapping[str, int],
+                      n_slices: int) -> Dict[str, int]:
+    """Per non-trivial mesh axis: how many slices a group varying only
+    that axis spans (entries only for axes that DO cross, span > 1).
+    On the canonical layout only `data` (the outermost axis) should
+    appear here; any other axis crossing DCN is the performance cliff
+    RLT306 flags."""
+    out: Dict[str, int] = {}
+    for ax in AXIS_ORDER:
+        if int(mesh_sizes.get(ax, 1)) <= 1:
+            continue
+        span = group_dcn_span((ax,), mesh_sizes, n_slices)
+        if span > 1:
+            out[ax] = span
+    return out
 
 
 def dp_degree(spec: MeshSpec) -> int:

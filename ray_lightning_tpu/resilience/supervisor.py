@@ -91,6 +91,16 @@ class ResilienceConfig:
     #: goodput classification into SupervisedResult.goodput +
     #: ``telemetry/goodput.json``. False disables end to end.
     telemetry: Any = True
+    #: elastic supervision (elastic/budget.py, docs/ELASTIC.md): an
+    #: ElasticBudget makes the world size a LADDER instead of a pin —
+    #: when the retry policy refuses another same-size relaunch (k
+    #: hosts gone for good), the supervisor reshards the latest valid
+    #: checkpoint onto the largest legal survivor world and resumes
+    #: smaller; when the budget's capacity oracle reports capacity
+    #: back, it grows on the next relaunch. Every change is recorded
+    #: in SupervisedResult.reshards with its honest batch plan. None
+    #: (default): fixed world size, exactly the old behavior.
+    elastic: Any = None
 
     def resolved_compile_cache_dir(self) -> Optional[str]:
         if self.compile_cache_dir == "off":
@@ -122,6 +132,17 @@ class SupervisedResult:
     #: (telemetry/goodput.py buckets; None when telemetry is off) —
     #: also written to <checkpoint_dir>/telemetry/goodput.json
     goodput: Optional[Dict[str, Any]] = None
+    #: elastic world-size changes, launch order (docs/ELASTIC.md): one
+    #: entry per shrink/grow with from/to world, reason, and the honest
+    #: batch plan (ElasticBudget.batch_plan)
+    reshards: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list)
+
+    @property
+    def final_world(self) -> Optional[int]:
+        """World size of the attempt that finished (None = unchanged
+        from launch)."""
+        return self.reshards[-1]["to_world"] if self.reshards else None
 
     @property
     def total_attempts(self) -> int:
@@ -266,15 +287,22 @@ def supervise(
             log.info("supervise: resuming from earlier run's %s", found)
             ckpt_path = found
 
-    monitor: Optional[HealthMonitor] = None
-    if (kind == "fit" and cfg.stall_timeout_s > 0
-            and cfg.heartbeat_interval_s > 0):
-        # fit only: HeartbeatCallback starts its sender in on_fit_start,
-        # which the eval-family jobs never fire — a monitor there would
-        # declare a healthy long validate() hung at startup_grace_s
-        monitor = HealthMonitor(
-            num_processes, stall_timeout_s=cfg.stall_timeout_s,
-            startup_grace_s=cfg.startup_grace_s)
+    world = num_processes
+    launch_world = num_processes
+
+    def _make_monitor(n: int) -> Optional[HealthMonitor]:
+        if (kind == "fit" and cfg.stall_timeout_s > 0
+                and cfg.heartbeat_interval_s > 0):
+            # fit only: HeartbeatCallback starts its sender in
+            # on_fit_start, which the eval-family jobs never fire — a
+            # monitor there would declare a healthy long validate()
+            # hung at startup_grace_s
+            return HealthMonitor(
+                n, stall_timeout_s=cfg.stall_timeout_s,
+                startup_grace_s=cfg.startup_grace_s)
+        return None
+
+    monitor: Optional[HealthMonitor] = _make_monitor(world)
 
     user_q = kw.pop("on_queue_item", None)
     user_watchdog = kw.pop("watchdog", None)
@@ -338,19 +366,21 @@ def supervise(
     rollbacks = 0
     quarantined: List[int] = []
     failures: List[Dict[str, Any]] = []
+    reshards: List[Dict[str, Any]] = []
     while True:
         if monitor is not None:
             monitor.reset()
         attempts = 1 + restarts + preemptions + rollbacks
         try:
             attempt_ctx = (driver_rec.span(PH_ATTEMPT,
-                                           meta={"attempt": attempts})
+                                           meta={"attempt": attempts,
+                                                 "world": world})
                            if driver_rec is not None
                            else contextlib.nullcontext())
             with attempt_ctx:
                 result = run_distributed(
                     kind, module_factory, wrapped_tf, data_factory,
-                    num_processes,
+                    world,
                     ckpt_path=ckpt_path,
                     on_queue_item=_on_queue_item,
                     watchdog=(_watchdog if (monitor is not None
@@ -361,7 +391,8 @@ def supervise(
             return SupervisedResult(result, restarts, preemptions,
                                     failures, rollbacks, quarantined,
                                     goodput=_assemble(
-                                        restarts, preemptions, rollbacks))
+                                        restarts, preemptions, rollbacks),
+                                    reshards=reshards)
         except BaseException as exc:
             if isinstance(exc, (KeyboardInterrupt, SystemExit)):
                 raise
@@ -375,7 +406,19 @@ def supervise(
                 # post-mortem report before failing for good
                 _assemble(restarts, preemptions, rollbacks)
                 raise SupervisedFailure(fc, attempts) from exc
-            if not policy.allows(restarts, preemptions, fc, rollbacks):
+            allowed = policy.allows(restarts, preemptions, fc, rollbacks)
+            new_world = None
+            if (kind == "fit" and cfg.elastic is not None
+                    and fc.kind != FailureKind.CORRUPTION):
+                # elastic supervision (docs/ELASTIC.md): a refused
+                # same-size relaunch becomes a SHRINK onto the largest
+                # legal survivor world; an allowed relaunch whose
+                # capacity oracle reports a different size moves toward
+                # it (growth back when capacity returns)
+                new_world = _elastic_target_world(
+                    cfg.elastic, world, launch_world, allowed,
+                    len(reshards))
+            if new_world is None and not allowed:
                 _assemble(restarts, preemptions, rollbacks)
                 raise RestartBudgetExceeded(
                     fc, attempts,
@@ -395,17 +438,137 @@ def supervise(
             elif kind == "fit":
                 found = latest_checkpoint(cfg.checkpoint_dir)
                 ckpt_path = found if found is not None else original_ckpt
+            if new_world is not None:
+                from ray_lightning_tpu.elastic.reshard import ReshardError
+
+                try:
+                    entry = _begin_reshard(cfg, world, new_world,
+                                           ckpt_path, attempts,
+                                           driver_rec)
+                except ReshardError as rexc:
+                    if allowed:
+                        # a refused resize (legacy resume source) must
+                        # not cost an otherwise-allowed same-size
+                        # relaunch — skip the resize, keep supervising
+                        log.error("supervise: elastic resize %d -> %d "
+                                  "refused (%s); relaunching same-size",
+                                  world, new_world, rexc)
+                    else:
+                        # the fixed-size budget is spent AND the resize
+                        # cannot proceed: terminal — land the goodput
+                        # postmortem like every other terminal path and
+                        # fail with the classified cause, the refusal
+                        # chained underneath
+                        _assemble(restarts, preemptions, rollbacks)
+                        raise RestartBudgetExceeded(
+                            fc, attempts, policy.max_restarts) from rexc
+                else:
+                    reshards.append(entry)
+                    world = new_world
+                    monitor = _make_monitor(world)
             log.warning(
                 "supervise: restart %d (retryable %d, preemptions %d, "
-                "rollbacks %d) in %.1fs, resuming from %s",
+                "rollbacks %d) in %.1fs at world %d, resuming from %s",
                 restarts + preemptions + rollbacks, restarts,
-                preemptions, rollbacks, delay, ckpt_path or "scratch")
+                preemptions, rollbacks, delay, world,
+                ckpt_path or "scratch")
             backoff_ctx = (driver_rec.span(PH_BACKOFF)
                            if driver_rec is not None
                            else contextlib.nullcontext())
             with backoff_ctx:
                 time.sleep(delay)
             backoff_s += delay
+
+
+def _elastic_target_world(budget, world: int, launch_world: int,
+                          allowed: bool,
+                          reshards_done: int) -> Optional[int]:
+    """The elastic supervision decision (docs/ELASTIC.md): given the
+    current world, whether the retry policy still allows a SAME-SIZE
+    relaunch, and how many topology changes were already spent, pick
+    the next world size — or None for "no change" (the caller then
+    relaunches same-size or, when !allowed, exhausts the budget).
+
+      * !allowed — the fixed-size story is over (k hosts are not
+        coming back within budget): shrink to the largest legal world
+        STRICTLY below the current one, bounded by reported capacity.
+      * allowed + the capacity oracle reports a different size: move
+        toward it (this is how a shrunk run grows back — the next
+        relaunch after capacity returns resumes at the bigger world).
+
+    Never proposes the current world, never exceeds max_reshards, and
+    only proposes rungs `ElasticBudget.legal` accepts (divisibility via
+    the plan checker's own MeshSpec/dp_degree machinery)."""
+    if budget is None or reshards_done >= budget.max_reshards:
+        return None
+    cap = min(budget.capacity(launch_world),
+              budget.resolved_max(launch_world))
+    if not allowed:
+        return budget.largest_legal(min(cap, world - 1), launch_world)
+    if cap != world:
+        target = budget.largest_legal(cap, launch_world)
+        if target is not None and target != world:
+            return target
+    return None
+
+
+def _begin_reshard(cfg: ResilienceConfig, world: int, new_world: int,
+                   ckpt_path: Optional[str], attempts: int,
+                   driver_rec) -> Dict[str, Any]:
+    """Validate + record one elastic world change. The resume source
+    must carry sharding provenance (a legacy checkpoint can only be
+    restored onto the identical sharding — resharding it would be a
+    silent lie about what was trained); the actual cross-topology
+    restore happens worker-side in the relaunched trainer
+    (core/trainer.py `_reshard_move`), accounted as the `reshard`
+    goodput bucket."""
+    from ray_lightning_tpu.checkpoint.io import read_meta
+    from ray_lightning_tpu.elastic.reshard import (
+        ReshardError,
+        validate_reshard,
+    )
+
+    move = None
+    if ckpt_path is not None:
+        meta = read_meta(ckpt_path)
+        if "mesh_spec" not in meta:
+            raise ReshardError(
+                f"elastic resize {world} -> {new_world} refused: resume "
+                f"source {ckpt_path} carries no sharding provenance "
+                "(legacy checkpoint — its writing mesh is unknowable, "
+                "so the move cannot be validated). Re-save it once on "
+                "the current mesh, or start the elastic run from a "
+                "provenance-stamped checkpoint")
+        # mesh-level validation against the WRITER's provenance, with
+        # the budget's REAL mesh template as the target (largest_legal
+        # only proposed worlds the template resolves at); the worker
+        # validates again against the mesh it actually builds
+        target_sizes = cfg.elastic.spec_for(new_world).resolve(
+            new_world).sizes()
+        move = validate_reshard(meta, target_sizes)["from_mesh"]
+    entry: Dict[str, Any] = {
+        "from_world": world,
+        "to_world": new_world,
+        "reason": "shrink" if new_world < world else "grow",
+        "attempt": attempts,
+        "at": time.time(),
+        "ckpt": ckpt_path,
+        "from_mesh": move,
+        "batch_plan": cfg.elastic.batch_plan(world, new_world),
+    }
+    log.warning(
+        "supervise: elastic %s %d -> %d (resuming from %s); batch "
+        "plan: %s", entry["reason"], world, new_world,
+        ckpt_path or "scratch",
+        entry["batch_plan"].get("note", "global batch preserved"))
+    if driver_rec is not None:
+        from ray_lightning_tpu.telemetry.spans import PH_RESHARD
+
+        with driver_rec.span(PH_RESHARD, meta={
+                k: entry[k] for k in ("from_world", "to_world",
+                                      "reason", "attempt")}):
+            pass
+    return entry
 
 
 def _rollback_target(cfg: ResilienceConfig, rollbacks: int,

@@ -16,6 +16,9 @@ does every TPU-fleet postmortem). Everything else gets a named bucket:
     rollback_replay_s   stepping time spent RE-training steps an earlier
                         attempt had already trained (restart/rollback
                         resume point behind the previous attempt's end)
+    reshard_s           elastic world-size changes (docs/ELASTIC.md):
+                        the cross-topology checkpoint restore after the
+                        supervisor shrank/grew the job
     other_s             driver-side residual (classification, teardown,
                         pump overhead) — wall minus everything above
 
@@ -52,7 +55,7 @@ from typing import Any, Dict, List, Optional
 GOODPUT_BUCKETS = (
     "productive_s", "compile_s", "data_wait_s", "ckpt_stall_s", "eval_s",
     "metrics_fetch_s", "launch_s", "backoff_s", "rollback_replay_s",
-    "other_s",
+    "reshard_s", "other_s",
 )
 
 #: the lost-time classes a fault-injected smoke run must show nonzero
@@ -74,6 +77,11 @@ _PHASE_TO_BUCKET = {
     "ckpt_stall": "ckpt_stall_s",
     "eval": "eval_s",
     "metrics_fetch": "metrics_fetch_s",
+    # elastic world-size changes (docs/ELASTIC.md): the worker-side
+    # cross-topology checkpoint restore after a shrink/grow — named so
+    # an elastic event is visible in `report`, not laundered into
+    # productive time
+    "reshard": "reshard_s",
 }
 
 

@@ -55,13 +55,17 @@ PH_EVAL = "eval"                # a validation/test epoch
 PH_BACKOFF = "backoff"          # supervisor restart backoff sleep (driver)
 PH_ATTEMPT = "attempt"          # one supervised launch, wall (driver)
 PH_ROLLBACK = "rollback"        # rollback target selection (driver)
+PH_RESHARD = "reshard"          # cross-topology checkpoint restore: the
+#                                 worker-side resharding load after an
+#                                 elastic world-size change (plus the
+#                                 driver's shrink/grow decision span)
 PH_STEP = "step"                # per-step host wall (batch_end to batch_end)
 
 #: every phase the schema knows; foreign phases are legal (the recorder
 #: is a vocabulary, not a validator) but the report groups them as-is
 PHASES = (
     PH_DATA_WAIT, PH_H2D, PH_DISPATCH, PH_METRICS, PH_CKPT, PH_COMPILE,
-    PH_EVAL, PH_BACKOFF, PH_ATTEMPT, PH_ROLLBACK, PH_STEP,
+    PH_EVAL, PH_BACKOFF, PH_ATTEMPT, PH_ROLLBACK, PH_RESHARD, PH_STEP,
 )
 
 # ---- serving phases (serve/, docs/SERVING.md) -----------------------------

@@ -68,6 +68,14 @@ RATCHETED = {
 #: backend-down skip lines and ratcheted there too, unlike measurements
 STATIC = {"overlap_hidden_fraction"}
 
+#: metric -> key for CEILING ratchets: lower is better, so the fresh
+#: value must stay <= the best (minimum) prior * (1 + tolerance).
+#: dcn_bytes_per_step is the static 2xv5p-64 trace's inter-slice bytes
+#: (ISSUE 9): DCN is the slow tier, so its per-step traffic may only
+#: shrink. Static class: ratchets on skip lines too; a line carrying
+#: multislice_error instead waives (analysis bug != regression).
+CEILING = {"dcn_bytes_per_step": "dcn_bytes_per_step"}
+
 #: metric -> max allowed value on a measured (non-skip) line; absent or
 #: null waives (bench.py reports null when the probe itself failed) —
 #: each bound exists to stop a latency/overhead class from growing, not
@@ -81,6 +89,12 @@ BOUNDED = {
     # hot path.
     "ttft_warm_s": float(
         os.environ.get("RLT_BENCH_TTFT_WARM_MAX", 2.0)),
+    # cross-topology restore (elastic leg, ISSUE 9): the wall seconds
+    # one elastic shrink/grow pays to reshard its ~32 MiB probe state.
+    # A growth here means the reshard path started gathering to host
+    # (or the storage layer regressed) — the elastic story's hot path.
+    "reshard_restore_s": float(
+        os.environ.get("RLT_BENCH_RESHARD_MAX", 30.0)),
 }
 
 
@@ -142,7 +156,33 @@ def best_prior(prior_glob: str, repo_root: str) -> dict:
     return best
 
 
-def gate(fresh: dict, best: dict, tolerance: float) -> list[str]:
+def ceiling_prior(prior_glob: str, repo_root: str) -> dict:
+    """Per-metric MIN over prior rounds for the CEILING metrics (lower
+    is better — the fresh value must not grow past it). All current
+    ceiling metrics are static, so every prior line that carries the
+    field contributes."""
+    best: dict = {}
+    for path in sorted(glob.glob(os.path.join(repo_root, prior_glob))):
+        try:
+            with open(path) as f:
+                line = _extract_line(json.load(f))
+        except (OSError, json.JSONDecodeError):
+            continue
+        if line is None:
+            continue
+        for name, key in CEILING.items():
+            v = line.get(key)
+            try:
+                v = float(v)
+            except (TypeError, ValueError):
+                continue
+            if name not in best or v < best[name][0]:
+                best[name] = (v, os.path.basename(path))
+    return best
+
+
+def gate(fresh: dict, best: dict, tolerance: float,
+         ceilings: Optional[dict] = None) -> list[str]:
     """Return the list of failure messages (empty = pass)."""
     skipped = "skipped" in fresh
     if skipped and "metric" not in fresh:
@@ -188,6 +228,30 @@ def gate(fresh: dict, best: dict, tolerance: float) -> list[str]:
                 f"{name}: {v:g} regressed below {floor:g} "
                 f"(best prior {prior:g} in {source}, "
                 f"tolerance {tolerance:.0%})")
+    for name, (prior, source) in (ceilings or {}).items():
+        key = CEILING[name]
+        v = fresh.get(key)
+        if v is None:
+            if "multislice_error" in fresh or "tracecheck_error" in fresh:
+                # the static trace died — an analysis failure is
+                # reported as its own error field, never as a deleted
+                # metric (same contract as the STATIC ratchet above)
+                continue
+            failures.append(
+                f"{name}: prior rounds track it ({prior:g} in {source}) "
+                f"but the fresh line dropped the field '{key}'")
+            continue
+        try:
+            v = float(v)
+        except (TypeError, ValueError):
+            failures.append(f"{name}: non-numeric value {v!r}")
+            continue
+        cap = prior * (1 + tolerance)
+        if v > cap:
+            failures.append(
+                f"{name}: {v:g} grew past {cap:g} (best prior {prior:g} "
+                f"in {source}, tolerance {tolerance:.0%}) — DCN is the "
+                "slow tier; its per-step traffic may only shrink")
     for key, bound in BOUNDED.items():
         if skipped:
             continue  # bounds apply to measured lines only
@@ -249,7 +313,8 @@ def main(argv=None) -> int:
         return 2
 
     best = best_prior(args.prior_glob, args.repo_root)
-    failures = gate(fresh, best, args.tolerance)
+    ceilings = ceiling_prior(args.prior_glob, args.repo_root)
+    failures = gate(fresh, best, args.tolerance, ceilings)
     if failures:
         for msg in failures:
             print(f"bench_gate: REGRESSION — {msg}", file=sys.stderr)

@@ -685,3 +685,58 @@ def test_rlt502_outer_loop_variable_in_nested_loop_fires():
         "        while not done:\n"
         "            out = step(params, toks[:, :l])\n")
     assert "RLT502" in rules_of(fs)
+
+
+# ---- RLT601 pinned-world-size (elastic/, docs/ELASTIC.md) ------------------
+
+
+def test_rlt601_pinned_count_assert_fires():
+    fs = lint(
+        "import jax\n"
+        "def setup():\n"
+        "    assert jax.device_count() == 8\n")
+    assert "RLT601" in rules_of(fs)
+
+
+def test_rlt601_len_devices_fires():
+    fs = lint(
+        "import jax\n"
+        "def setup():\n"
+        "    if len(jax.devices()) != 16:\n"
+        "        raise RuntimeError('need 16')\n")
+    assert "RLT601" in rules_of(fs)
+
+
+def test_rlt601_batch_div_literal_fires():
+    fs = lint(
+        "def shard(global_batch, rank):\n"
+        "    per_host = global_batch // 8\n"
+        "    lane = rank % 4\n"
+        "    return per_host, lane\n")
+    assert len([f for f in fs if f.rule == "RLT601"]) == 2
+
+
+def test_rlt601_capability_checks_sanctioned():
+    # == 1 / > 1 are capability gates, not world-size pins; mesh-derived
+    # divisors are names/calls, never literals
+    fs = lint(
+        "import jax\n"
+        "from ray_lightning_tpu.parallel import mesh as mesh_lib\n"
+        "def shard(batch, mesh, accum, seq):\n"
+        "    if jax.process_count() == 1:\n"
+        "        pass\n"
+        "    if jax.process_count() > 1:\n"
+        "        pass\n"
+        "    per = batch // mesh_lib.batch_size_divisor(mesh)\n"
+        "    micro = batch // accum\n"
+        "    half = seq // 2\n"
+        "    odd = batch // 3\n"
+        "    return per, micro, half, odd\n")
+    assert "RLT601" not in rules_of(fs)
+
+
+def test_rlt601_suppressible():
+    fs = lint(
+        "def shard(global_batch):\n"
+        "    return global_batch // 8  # rlt: disable=RLT601\n")
+    assert "RLT601" not in rules_of(fs)

@@ -1,0 +1,283 @@
+"""elastic/budget.py + supervisor elastic integration (ISSUE 9).
+
+Fast legs: budget legality rides the plan checker's own divisibility
+machinery (MeshSpec.resolve / dp_degree); the supervisor's decision
+function shrinks exactly when the same-size relaunch is refused and
+grows exactly when capacity returns; the goodput vocabulary knows the
+`reshard` phase. The full kill -> shrink -> converge drill runs as a
+slow 2-proc test (and as the format.sh `elastic --smoke` gate).
+"""
+import jax.numpy as jnp
+import pytest
+
+from ray_lightning_tpu.elastic import ElasticBudget
+from ray_lightning_tpu.parallel.mesh import MeshSpec
+from ray_lightning_tpu.resilience.supervisor import (
+    ResilienceConfig,
+    _elastic_target_world,
+)
+
+
+# ---- budget legality -------------------------------------------------------
+
+
+def test_legal_worlds_default_template():
+    b = ElasticBudget(min_world=1)
+    assert b.legal_worlds(4) == [1, 2, 3, 4]
+    assert b.largest_legal(3, 4) == 3
+    assert b.largest_legal(0, 4) is None
+
+
+def test_divisibility_via_mesh_template():
+    # a fixed tensor=2 axis: only even worlds resolve — the SAME
+    # refusal MeshSpec.resolve gives the pre-flight plan checker
+    b = ElasticBudget(
+        min_world=2,
+        spec_for=lambda w: MeshSpec(data=-1, tensor=2))
+    assert b.legal_worlds(8) == [2, 4, 6, 8]
+    assert b.largest_legal(7, 8) == 6
+    assert not b.legal(3, 8)
+
+
+def test_divisible_by_and_bounds():
+    b = ElasticBudget(min_world=4, max_world=12, divisible_by=4)
+    assert b.legal_worlds(16) == [4, 8, 12]
+    assert b.largest_legal(16, 16) == 12   # capped by max_world
+    assert b.largest_legal(3, 16) is None  # below min_world
+
+
+def test_global_batch_divisibility():
+    # global batch 48 on an all-data mesh: dp degree == world
+    b = ElasticBudget(min_world=1, global_batch=48)
+    assert b.legal(6, 8) and b.legal(8, 8)
+    assert not b.legal(5, 8)  # 48 % 5 != 0
+
+
+def test_batch_plan_honesty():
+    b = ElasticBudget(min_world=1, global_batch=64)
+    plan = b.batch_plan(8, 4)
+    assert plan["old_dp"] == 8 and plan["new_dp"] == 4
+    assert plan["grad_accum_to_preserve"] == 2
+    assert plan["global_batch_preserved"] is False
+    assert plan["replanned_global_batch"] == 32
+    # no whole factor: 8 -> 3
+    plan = b.batch_plan(8, 3)
+    assert "grad_accum_to_preserve" not in plan
+    assert "re-planned" in plan["note"]
+    # same dp: preserved
+    assert b.batch_plan(4, 4)["global_batch_preserved"] is True
+
+
+def test_capacity_oracle_fallback_and_failure():
+    b = ElasticBudget(min_world=1)
+    assert b.capacity(8) == 8  # no oracle: assumed restored at max
+    b = ElasticBudget(min_world=1, capacity_fn=lambda: 5)
+    assert b.capacity(8) == 5
+    def boom():
+        raise RuntimeError("oracle down")
+    b = ElasticBudget(min_world=1, capacity_fn=boom)
+    assert b.capacity(8) == 0  # broken oracle reads as nothing back
+
+
+# ---- the supervisor's decision function ------------------------------------
+
+
+def test_shrink_only_when_same_size_refused():
+    b = ElasticBudget(min_world=1)
+    # policy still allows a same-size relaunch and capacity is full:
+    # no change
+    assert _elastic_target_world(b, 2, 2, True, 0) is None
+    # refused: shrink strictly below
+    assert _elastic_target_world(b, 2, 2, False, 0) == 1
+    # refused at min_world: nothing left
+    assert _elastic_target_world(b, 1, 2, False, 0) is None
+
+
+def test_grow_on_capacity_return():
+    calls = {"cap": 1}
+    b = ElasticBudget(min_world=1, capacity_fn=lambda: calls["cap"])
+    # shrunk to 1 earlier; capacity still 1: no change
+    assert _elastic_target_world(b, 1, 4, True, 1) is None
+    # capacity returns: grow back toward it on the next relaunch
+    calls["cap"] = 4
+    assert _elastic_target_world(b, 1, 4, True, 1) == 4
+    # capacity above launch world never exceeds the resolved max
+    calls["cap"] = 16
+    assert _elastic_target_world(b, 1, 4, True, 1) == 4
+
+
+def test_reshard_budget_caps_changes():
+    b = ElasticBudget(min_world=1, max_reshards=1)
+    assert _elastic_target_world(b, 2, 2, False, 0) == 1
+    assert _elastic_target_world(b, 2, 2, False, 1) is None  # spent
+
+
+def test_capacity_loss_shrinks_even_when_allowed():
+    # the oracle says only 2 of 4 hosts exist: move toward capacity on
+    # an allowed relaunch instead of thrashing the full-size launch
+    b = ElasticBudget(min_world=1, capacity_fn=lambda: 2)
+    assert _elastic_target_world(b, 4, 4, True, 0) == 2
+
+
+def test_no_budget_means_fixed_world():
+    assert _elastic_target_world(None, 2, 2, False, 0) is None
+
+
+# ---- reshard goodput vocabulary --------------------------------------------
+
+
+def test_goodput_reshard_bucket():
+    from ray_lightning_tpu.telemetry.goodput import (
+        GOODPUT_BUCKETS,
+        _PHASE_TO_BUCKET,
+    )
+    from ray_lightning_tpu.telemetry.spans import PH_RESHARD, PHASES
+
+    assert "reshard_s" in GOODPUT_BUCKETS
+    assert PH_RESHARD in PHASES
+    assert _PHASE_TO_BUCKET[PH_RESHARD] == "reshard_s"
+
+
+def test_worker_ledger_carries_reshard(tmp_path):
+    from ray_lightning_tpu.telemetry.goodput import worker_ledger
+    from ray_lightning_tpu.telemetry.spans import (
+        PH_RESHARD,
+        TelemetryRecorder,
+    )
+
+    import time
+
+    rec = TelemetryRecorder(directory=str(tmp_path), rank=0)
+    rec.record(PH_RESHARD, time.perf_counter(), 0.25, step=0)
+    led = worker_ledger(rec, 10.0, rank=0, start_step=0, end_step=5)
+    rec.close()
+    assert led["buckets"]["reshard_s"] == pytest.approx(0.25)
+    # buckets still sum to wall exactly (productive closes the books)
+    assert sum(led["buckets"].values()) == pytest.approx(10.0)
+
+
+# ---- supervisor config surface ---------------------------------------------
+
+
+def test_resilience_config_carries_elastic(tmp_path):
+    cfg = ResilienceConfig(checkpoint_dir=str(tmp_path),
+                           elastic=ElasticBudget(min_world=1))
+    assert cfg.elastic.min_world == 1
+
+
+def test_begin_reshard_refuses_legacy_checkpoint(tmp_path):
+    """An elastic resize whose resume source has no provenance must
+    fail with the gap named, never silently move a legacy
+    checkpoint."""
+    from ray_lightning_tpu.checkpoint.io import (
+        save_checkpoint,
+        wait_for_checkpoints,
+    )
+    from ray_lightning_tpu.elastic.reshard import ReshardError
+    from ray_lightning_tpu.resilience.supervisor import _begin_reshard
+
+    path = str(tmp_path / "legacy")
+    save_checkpoint(path, {"params": {"w": jnp.ones((4,))}},
+                    {"global_step": 3})
+    wait_for_checkpoints()
+    cfg = ResilienceConfig(checkpoint_dir=str(tmp_path),
+                           elastic=ElasticBudget(min_world=1))
+    with pytest.raises(ReshardError, match="no sharding provenance"):
+        _begin_reshard(cfg, 2, 1, path, 2, None)
+
+
+def test_begin_reshard_records_ledger_entry(tmp_path):
+    from ray_lightning_tpu.checkpoint.io import (
+        save_checkpoint,
+        sharding_provenance,
+        wait_for_checkpoints,
+    )
+    from ray_lightning_tpu.parallel.strategy import DataParallel
+    from ray_lightning_tpu.resilience.supervisor import _begin_reshard
+
+    s = DataParallel(num_workers=2)
+    s.setup()
+    state = {"params": s.shard_params({"w": jnp.ones((8,))})}
+    path = str(tmp_path / "ck")
+    save_checkpoint(path, state,
+                    {"global_step": 3,
+                     **sharding_provenance(s.mesh, state)})
+    wait_for_checkpoints()
+    cfg = ResilienceConfig(checkpoint_dir=str(tmp_path),
+                           elastic=ElasticBudget(min_world=1))
+    entry = _begin_reshard(cfg, 2, 1, path, 2, None)
+    assert entry["from_world"] == 2 and entry["to_world"] == 1
+    assert entry["reason"] == "shrink"
+    assert entry["from_mesh"] == {"data": 2}
+    assert entry["batch_plan"]["new_dp"] == 1
+
+
+# ---- the full drill (slow; also the format.sh elastic --smoke gate) --------
+
+
+@pytest.mark.slow
+def test_supervised_shrink_2proc_converges(tmp_path):
+    """Kill one of two workers with the same-size relaunch refused
+    (max_restarts=0): the supervisor must consult the budget, reshard
+    onto the survivor (world 2 -> 1), resume, and converge — with the
+    world change in the ledger and the reshard_s goodput bucket
+    present."""
+    from ray_lightning_tpu.elastic.cli import (
+        _smoke_data,
+        _smoke_module,
+        _smoke_trainer,
+    )
+    from ray_lightning_tpu.resilience.policy import RetryPolicy
+    from ray_lightning_tpu.resilience.supervisor import fit_supervised
+
+    cfg = ResilienceConfig(
+        checkpoint_dir=str(tmp_path / "shrink"),
+        policy=RetryPolicy(max_restarts=0, backoff_base_s=0.2,
+                           jitter=0.0),
+        save_every_n_steps=1,
+        stall_timeout_s=0.0,
+        heartbeat_interval_s=1.0,
+        elastic=ElasticBudget(min_world=1, max_reshards=2),
+        faults="kill:rank=1,step=3",
+    )
+    supervised = fit_supervised(
+        _smoke_module, _smoke_trainer, _smoke_data, 2,
+        resilience=cfg, platform="cpu", num_cpu_devices_per_process=1,
+        return_weights=False, timeout=300.0)
+    assert len(supervised.reshards) == 1
+    assert supervised.reshards[0]["from_world"] == 2
+    assert supervised.reshards[0]["to_world"] == 1
+    assert supervised.final_world == 1
+    acc = supervised.result.metrics.get("ptl/val_accuracy")
+    assert acc is not None and float(acc) > 0.8
+    buckets = (supervised.goodput or {}).get("buckets") or {}
+    assert "reshard_s" in buckets
+
+
+def test_begin_reshard_validates_against_real_template(tmp_path):
+    # review regression: the driver validates the move against the
+    # budget's REAL mesh template, not a fabricated all-data mesh
+    from ray_lightning_tpu.checkpoint.io import (
+        save_checkpoint,
+        sharding_provenance,
+        wait_for_checkpoints,
+    )
+    from ray_lightning_tpu.parallel.mesh import MeshSpec
+    from ray_lightning_tpu.parallel.strategy import FSDP
+    from ray_lightning_tpu.resilience.supervisor import _begin_reshard
+
+    s = FSDP(num_workers=4, min_shard_size=8)
+    s.setup()
+    state = {"params": s.shard_params({"w": jnp.ones((8, 8))})}
+    path = str(tmp_path / "ck")
+    save_checkpoint(path, state,
+                    {"global_step": 3,
+                     **sharding_provenance(s.mesh, state)})
+    wait_for_checkpoints()
+    cfg = ResilienceConfig(
+        checkpoint_dir=str(tmp_path),
+        elastic=ElasticBudget(min_world=1,
+                              spec_for=lambda w: MeshSpec(fsdp=w)))
+    entry = _begin_reshard(cfg, 4, 2, path, 2, None)
+    assert entry["from_mesh"] == {"fsdp": 4}
+    assert entry["batch_plan"]["new_dp"] == 2

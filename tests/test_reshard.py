@@ -1,0 +1,286 @@
+"""elastic/reshard.py — cross-topology checkpoint restore (ISSUE 9).
+
+The contract under test (docs/ELASTIC.md "resharding restore"): a
+checkpoint written on ANY mesh restores onto ANY other mesh's
+shardings — bitwise, leaf for leaf, opt-state and extra (guard/EMA)
+slots included — validated against the provenance the writer stamped;
+legacy (provenance-free) checkpoints refuse the move with a clear
+error instead of restoring a fiction.
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.checkpoint.io import (
+    read_meta,
+    save_checkpoint,
+    sharding_provenance,
+    verify_checkpoint,
+    wait_for_checkpoints,
+)
+from ray_lightning_tpu.elastic import (
+    ElasticBudget,  # noqa: F401 — re-export sanity
+    ReshardError,
+    checkpoint_provenance,
+    reshard_restore,
+    validate_reshard,
+)
+from ray_lightning_tpu.parallel.strategy import (
+    DataParallel,
+    FSDP,
+    ShardedMesh,
+)
+
+
+def _state(strategy):
+    """A small but multi-leaf state on `strategy`'s mesh: params with
+    shardable dims, a nested opt-state inheriting param layouts, and a
+    guard/EMA-style scalar slot."""
+    params = {"w": jnp.arange(64, dtype=jnp.float32).reshape(8, 8),
+              "b": jnp.arange(8.0),
+              "deep": {"k": jnp.arange(32, dtype=jnp.float32)
+                       .reshape(4, 8)}}
+    params = strategy.shard_params(params)
+    opt = {"mu": jax.tree.map(lambda x: x * 2.0, params),
+           "nu": jax.tree.map(lambda x: x * 3.0, params)}
+    return {
+        "params": params,
+        "opt_state": opt,
+        "guard": {"loss_ema": jax.device_put(
+            jnp.float32(1.25), strategy.replicated())},
+        "step": jax.device_put(jnp.int32(11), strategy.replicated()),
+    }
+
+
+def _target_like(strategy, host_state):
+    params = strategy.shard_params(
+        jax.tree.map(jnp.zeros_like, host_state["params"]))
+    opt = jax.tree.map(jnp.zeros_like, host_state["opt_state"])
+    opt = jax.device_put(opt, strategy.opt_state_shardings(
+        jax.eval_shape(lambda t: t, opt), params))
+    return {
+        "params": params,
+        "opt_state": opt,
+        "guard": {"loss_ema": jax.device_put(
+            jnp.zeros((), jnp.float32), strategy.replicated())},
+        "step": jax.device_put(jnp.zeros((), jnp.int32),
+                               strategy.replicated()),
+    }
+
+
+def _save(tmp_path, strategy, state, name="ck", extra_meta=None):
+    path = os.path.join(str(tmp_path), name)
+    meta = {"global_step": 11,
+            **sharding_provenance(strategy.mesh, state)}
+    meta.update(extra_meta or {})
+    save_checkpoint(path, state, meta)
+    wait_for_checkpoints()
+    return path
+
+
+def _assert_bitwise(src_state, restored):
+    a = jax.device_get(src_state)
+    b = jax.device_get(restored)
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+@pytest.mark.parametrize("dst_factory", [
+    lambda: FSDP(num_workers=4, min_shard_size=8),        # fsdp 8 -> 4
+    lambda: DataParallel(num_workers=8),                  # fsdp -> dp swap
+    lambda: ShardedMesh(data=2, fsdp=4, min_shard_size=8),  # hybrid
+    lambda: FSDP(num_workers=2, min_shard_size=8),        # world 8 -> 2
+], ids=["fsdp8to4", "fsdp-to-dp", "hsdp", "world8to2"])
+def test_mesh_to_mesh_bitwise(tmp_path, dst_factory):
+    src = FSDP(min_shard_size=8)
+    src.setup()
+    state = _state(src)
+    path = _save(tmp_path, src, state)
+
+    dst = dst_factory()
+    dst.setup()
+    restored = reshard_restore(path, _target_like(dst, jax.device_get(state)))
+    _assert_bitwise(state, restored)
+    assert int(jax.device_get(restored["step"])) == 11
+    # the restored tree really lives on the TARGET mesh
+    tgt_mesh = jax.tree.leaves(restored["params"])[0].sharding.mesh
+    assert int(tgt_mesh.size) == dst.world_size
+
+
+def test_reverse_move_dp_to_fsdp(tmp_path):
+    src = DataParallel(num_workers=4)
+    src.setup()
+    state = _state(src)
+    path = _save(tmp_path, src, state)
+    dst = FSDP(min_shard_size=8)
+    dst.setup()
+    restored = reshard_restore(path, _target_like(dst, jax.device_get(state)))
+    _assert_bitwise(state, restored)
+
+
+def test_provenance_stamped_and_verified(tmp_path):
+    src = FSDP(min_shard_size=8)
+    src.setup()
+    state = _state(src)
+    path = _save(tmp_path, src, state)
+    meta = read_meta(path)
+    assert meta["mesh_spec"]["fsdp"] == 8
+    assert meta["topology"]["n_devices"] == 8
+    assert meta["topology"]["platform"] == "cpu"
+    # per-leaf specs recorded for every param leaf
+    assert set(meta["param_specs"]) == {"w", "b", "deep/k"}
+    prov = checkpoint_provenance(path)
+    assert set(prov) == {"mesh_spec", "topology", "param_specs"}
+    ok, reason = verify_checkpoint(path)
+    assert ok, reason
+
+
+def test_verify_rejects_contradictory_provenance(tmp_path):
+    src = FSDP(min_shard_size=8)
+    src.setup()
+    state = _state(src)
+    path = _save(tmp_path, src, state)
+    # tamper: mesh product no longer matches recorded device count
+    import json
+
+    mp = os.path.join(path, "meta.json")
+    with open(mp) as f:
+        meta = json.load(f)
+    meta["topology"]["n_devices"] = 3
+    with open(mp, "w") as f:
+        json.dump(meta, f)
+    ok, reason = verify_checkpoint(path)
+    assert not ok and "provenance mismatch" in reason
+    # and the reshard path refuses it too
+    with pytest.raises(ReshardError, match="provenance is invalid"):
+        validate_reshard(meta, {"fsdp": 4})
+
+
+def test_verify_rejects_alien_axis_in_param_specs(tmp_path):
+    src = FSDP(min_shard_size=8)
+    src.setup()
+    state = _state(src)
+    path = _save(tmp_path, src, state)
+    import json
+
+    mp = os.path.join(path, "meta.json")
+    with open(mp) as f:
+        meta = json.load(f)
+    meta["param_specs"]["w"] = [None, "bogus_axis"]
+    with open(mp, "w") as f:
+        json.dump(meta, f)
+    ok, reason = verify_checkpoint(path)
+    assert not ok and "bogus_axis" in reason
+
+
+def test_legacy_meta_refuses_reshard(tmp_path):
+    """A checkpoint without provenance restores legacy-style only —
+    reshard_restore names the gap instead of moving it."""
+    src = FSDP(min_shard_size=8)
+    src.setup()
+    state = _state(src)
+    path = os.path.join(str(tmp_path), "legacy")
+    save_checkpoint(path, state, {"global_step": 11})  # no provenance
+    wait_for_checkpoints()
+    ok, reason = verify_checkpoint(path)
+    assert ok, reason  # legacy checkpoints still VERIFY fine
+    dst = FSDP(num_workers=4, min_shard_size=8)
+    dst.setup()
+    with pytest.raises(ReshardError, match="no sharding provenance"):
+        reshard_restore(path, _target_like(dst, jax.device_get(state)))
+    # ...but the legacy same-sharding path still works
+    from ray_lightning_tpu.checkpoint.io import restore_checkpoint
+
+    same = FSDP(min_shard_size=8)
+    same.setup()
+    restored = restore_checkpoint(
+        path, _target_like(same, jax.device_get(state)))
+    _assert_bitwise(state, restored)
+
+
+def test_validate_reshard_move_summary():
+    meta = {"mesh_spec": {"data": 1, "fsdp": 8, "tensor": 1},
+            "topology": {"n_devices": 8},
+            "param_specs": {"w": [None, "fsdp"]}}
+    move = validate_reshard(meta, {"data": 2, "fsdp": 2})
+    assert move["from_mesh"] == {"fsdp": 8}
+    assert move["to_mesh"] == {"data": 2, "fsdp": 2}
+    assert move["from_world"] == 8 and move["to_world"] == 4
+    assert move["world_change"] is True
+    assert move["changed_axes"] == ["data", "fsdp"]
+    # identical live mesh: legal, no world change
+    move = validate_reshard(meta, {"fsdp": 8})
+    assert move["world_change"] is False and move["changed_axes"] == []
+
+
+def test_reshard_restore_refuses_torn_checkpoint(tmp_path):
+    src = FSDP(min_shard_size=8)
+    src.setup()
+    state = _state(src)
+    path = _save(tmp_path, src, state)
+    os.remove(os.path.join(path, "meta.json"))  # torn: no completeness
+    dst = FSDP(num_workers=4, min_shard_size=8)
+    dst.setup()
+    with pytest.raises(ReshardError, match="invalid checkpoint"):
+        reshard_restore(path, _target_like(dst, jax.device_get(state)))
+
+
+def test_trainer_restore_reshards_across_meshes(tmp_path):
+    """End to end through the Trainer: fit on fsdp=8, checkpoint, then
+    a FRESH trainer on fsdp=4 resumes from it — the cross-topology
+    restore path (`_reshard_move`) validates the move and training
+    continues with bitwise-equal restored params."""
+    from ray_lightning_tpu import DataLoader, Trainer
+    from tests.utils import BoringModel, random_dataset
+
+    data = random_dataset()
+
+    m1 = BoringModel()
+    t1 = Trainer(strategy=FSDP(min_shard_size=8), max_epochs=1,
+                 enable_progress_bar=False, enable_checkpointing=False,
+                 default_root_dir=str(tmp_path), seed=0)
+    t1.fit(m1, DataLoader(data, batch_size=16),
+           DataLoader(data, batch_size=16))
+    ck = t1.save_checkpoint(str(tmp_path / "ck"))
+    wait_for_checkpoints()
+    saved = jax.device_get({"params": t1.state.params,
+                            "opt_state": t1.state.opt_state,
+                            "step": t1.state.step})
+    meta = read_meta(ck)
+    assert meta["mesh_spec"]["fsdp"] == 8
+
+    # the move the fsdp=4 trainer will perform, validated standalone
+    move = validate_reshard(meta, {"fsdp": 4})
+    assert move["from_mesh"] == {"fsdp": 8}
+    assert move["to_mesh"] == {"fsdp": 4}
+
+    # standalone full-tree reshard restore: bitwise vs the saved state
+    dst = FSDP(num_workers=4, min_shard_size=8)
+    dst.setup()
+    tgt_params = dst.shard_params(
+        jax.tree.map(jnp.zeros_like, saved["params"]))
+    tgt_opt = jax.tree.map(jnp.zeros_like, saved["opt_state"])
+    tgt_opt = jax.device_put(tgt_opt, dst.opt_state_shardings(
+        jax.eval_shape(lambda t: t, tgt_opt), tgt_params))
+    restored = reshard_restore(ck, {
+        "params": tgt_params, "opt_state": tgt_opt,
+        "step": jax.device_put(jnp.zeros((), jnp.int32),
+                               dst.replicated())})
+    for a, b in zip(jax.tree.leaves(saved),
+                    jax.tree.leaves(jax.device_get(restored))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # end to end: a FRESH trainer on the 4-device mesh resumes from the
+    # 8-device checkpoint (the Trainer's _reshard_move path) and trains
+    m2 = BoringModel()
+    t2 = Trainer(strategy=FSDP(num_workers=4, min_shard_size=8),
+                 max_epochs=2, enable_progress_bar=False,
+                 enable_checkpointing=False,
+                 default_root_dir=str(tmp_path), seed=0)
+    metrics = t2.fit(m2, DataLoader(data, batch_size=16),
+                     DataLoader(data, batch_size=16), ckpt_path=ck)
+    assert t2.global_step > int(saved["step"])
+    assert "ptl/val_accuracy" in metrics or metrics  # trained through

@@ -1,0 +1,288 @@
+"""Multi-slice (DCN) cost-model + tracecheck tier itemization (ISSUE 9,
+docs/ELASTIC.md "DCN cost model" / docs/STATIC_ANALYSIS.md).
+
+The contract: `parse_topology("2xv5p-64")` is two slices over DCN;
+crossing collectives are priced hierarchically (ICI intra stage + DCN
+inter stage on the intra-reduced payload); the slice-major layout math
+says which mesh axes cross; tracecheck itemizes dcn_bytes per event and
+flags non-`data` crossing axes as RLT306 — the data-across-slices HSDP
+placement audits clean.
+"""
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.analysis.costmodel import (
+    DCN_SPECS,
+    Topology,
+    collective_cost,
+    parse_topology,
+)
+from ray_lightning_tpu.parallel.plan import (
+    dcn_crossing_axes,
+    group_dcn_span,
+)
+
+
+# ---- parse_topology --------------------------------------------------------
+
+
+def test_parse_multislice_topology():
+    t = parse_topology("2xv5p-64")
+    assert t.n_slices == 2
+    assert t.n_devices == 128          # two slices OF 64
+    assert t.devices_per_slice == 64
+    assert t.device_kind == "TPU v5p"
+    assert t.dcn_gbps == DCN_SPECS["v5p"][0]
+    assert "2 slices" in t.describe()
+
+
+def test_parse_single_slice_unchanged():
+    t = parse_topology("v5p-64")
+    assert t.n_slices == 1 and t.n_devices == 64
+    assert t.dcn_gbps is not None  # resolved, just unused
+
+
+def test_parse_rejects_garbage():
+    with pytest.raises(ValueError, match="cannot parse"):
+        parse_topology("2x-64")
+    with pytest.raises(ValueError, match="unknown topology family"):
+        parse_topology("3xv9z-8")
+
+
+def test_topology_rejects_uneven_slices():
+    with pytest.raises(ValueError, match="equal slices"):
+        Topology(name="bad", device_kind="TPU v5p", n_devices=10,
+                 ici_gbps=600.0, ici_hop_latency_us=1.0,
+                 hbm_bytes=1 << 30, n_slices=4)
+
+
+# ---- slice-layout math (parallel/plan.py) ----------------------------------
+
+
+def test_group_dcn_span_data_outermost():
+    sizes = {"data": 2, "fsdp": 64}
+    assert group_dcn_span(("data",), sizes, 2) == 2
+    assert group_dcn_span(("fsdp",), sizes, 2) == 1
+    assert group_dcn_span(("data", "fsdp"), sizes, 2) == 2
+    assert group_dcn_span(("data",), sizes, 1) == 1  # single slice
+
+
+def test_group_dcn_span_fsdp_across():
+    # no data axis: fsdp IS the outermost non-trivial axis and spans
+    # both slices
+    assert group_dcn_span(("fsdp",), {"fsdp": 128}, 2) == 2
+    # data=4 over 2 slices: 2 data-coords per slice — the data group
+    # touches both slices, fsdp stays inside one
+    sizes = {"data": 4, "fsdp": 8}
+    assert group_dcn_span(("data",), sizes, 2) == 2
+    assert group_dcn_span(("fsdp",), sizes, 2) == 1
+
+
+def test_dcn_crossing_axes():
+    assert dcn_crossing_axes({"data": 2, "fsdp": 64}, 2) == {"data": 2}
+    assert dcn_crossing_axes({"fsdp": 128}, 2) == {"fsdp": 2}
+    assert dcn_crossing_axes({"data": 2, "fsdp": 64}, 1) == {}
+    # tensor inside a slice, data across
+    out = dcn_crossing_axes({"data": 2, "tensor": 4}, 2)
+    assert out == {"data": 2}
+
+
+# ---- hierarchical collective_cost ------------------------------------------
+
+
+def _topo(n, s):
+    return Topology(name=f"{s}xcpu-{n // s}", device_kind="cpu",
+                    n_devices=n, ici_gbps=100.0, ici_hop_latency_us=0.0,
+                    hbm_bytes=1 << 30, n_slices=s, dcn_gbps=10.0,
+                    dcn_hop_latency_us=0.0)
+
+
+def test_psum_hierarchical_split():
+    # group 8 over 2 slices: intra ring of 4 on ICI, inter ring of 2 on
+    # the reduce-scattered payload (P/4) on DCN
+    P = 1 << 20
+    c = collective_cost("psum", P, {"data": 8}, _topo(8, 2), dcn_group=2)
+    assert c.wire_bytes == int(2 * P * 3 / 4)
+    assert c.dcn_bytes == int(2 * (P / 4) * 1 / 2)
+    assert c.dcn_time_us > 0
+    # single-slice call unchanged (back-compat)
+    c1 = collective_cost("psum", P, {"data": 8}, _topo(8, 2))
+    assert c1.dcn_bytes == 0
+    assert c1.wire_bytes == int(2 * P * 7 / 8)
+
+
+def test_pure_cross_slice_psum_all_dcn():
+    P = 1 << 20
+    c = collective_cost("psum", P, {"data": 2}, _topo(2, 2), dcn_group=2)
+    assert c.wire_bytes == 0          # no intra stage (n_intra == 1)
+    assert c.dcn_bytes == int(2 * P * 1 / 2)
+
+
+def test_all_gather_and_ppermute_split():
+    F = 1 << 20
+    c = collective_cost("all_gather", F, {"data": 8}, _topo(8, 2),
+                        dcn_group=2)
+    assert c.wire_bytes == int(F * 3 / 4)
+    assert c.dcn_bytes == int((F / 4) * 1 / 2)
+    # a crossing ppermute rides DCN whole, one hop
+    c = collective_cost("ppermute", F, {"data": 2}, _topo(8, 2),
+                        dcn_group=2)
+    assert c.wire_bytes == 0 and c.dcn_bytes == F
+
+
+def test_all_to_all_no_intra_reduction():
+    # all_to_all sends raw chunks: the remote (s-1)/s fraction crosses
+    # DCN at FULL size — no /n_intra shrink (review finding: the
+    # hierarchical shortcut would undercharge by n_intra)
+    P = 1 << 20
+    c = collective_cost("all_to_all", P, {"expert": 8}, _topo(8, 2),
+                        dcn_group=2)
+    assert c.dcn_bytes == int(P * 1 / 2)
+    assert c.wire_bytes == int(P * 3 / 8)  # (n_intra-1)/n stays on ICI
+    # single-slice unchanged
+    c1 = collective_cost("all_to_all", P, {"expert": 8}, _topo(8, 2))
+    assert c1.dcn_bytes == 0 and c1.wire_bytes == int(P * 7 / 8)
+
+
+# ---- tracecheck itemization + RLT306 ---------------------------------------
+
+
+def _audit(strategy, topo_name, batch_rows=16):
+    from ray_lightning_tpu.analysis.tracecheck import audit_step
+    from ray_lightning_tpu.models.mlp import MLPClassifier
+
+    return audit_step(
+        MLPClassifier(features=(32,), num_classes=4), strategy,
+        {"x": np.zeros((batch_rows, 8), np.float32),
+         "y": np.zeros((batch_rows,), np.int32)},
+        topology=topo_name)
+
+
+def test_data_across_slices_audits_clean_with_dcn_bytes():
+    from ray_lightning_tpu.parallel.strategy import DataParallel
+
+    report = _audit(DataParallel(), "2xcpu-2")
+    assert report.topology.n_slices == 2
+    assert report.dcn_bytes_per_step > 0       # grad psum crosses DCN
+    assert not any(f.rule == "RLT306" for f in report.findings)
+    # the JSON carries the tier split per event and in total
+    d = report.to_dict()
+    assert d["dcn_bytes_per_step"] == report.dcn_bytes_per_step
+    assert d["topology"]["n_slices"] == 2
+    assert any(e["dcn_bytes"] > 0 for e in d["collectives"])
+    assert "DCN total" in report.summary()
+
+
+def test_fsdp_across_slices_flags_rlt306():
+    from ray_lightning_tpu.parallel.strategy import FSDP
+
+    report = _audit(FSDP(min_shard_size=8), "2xcpu-2")
+    flagged = [f for f in report.findings if f.rule == "RLT306"]
+    assert flagged, [f.rule for f in report.findings]
+    assert "fsdp" in flagged[0].message
+    assert "data" in flagged[0].message  # names the fix
+
+
+def test_hsdp_placement_keeps_fsdp_on_ici():
+    from ray_lightning_tpu.parallel.strategy import ShardedMesh
+
+    report = _audit(ShardedMesh(data=2, fsdp=2, min_shard_size=8),
+                    "2xcpu-2")
+    assert not any(f.rule == "RLT306" for f in report.findings)
+    # fsdp collectives (weight gathers) carry NO dcn bytes; data psums do
+    for e in report.collectives:
+        if e.axes == ("fsdp",):
+            assert e.dcn_bytes == 0
+
+
+def test_single_slice_reports_zero_dcn():
+    from ray_lightning_tpu.parallel.strategy import DataParallel
+
+    report = _audit(DataParallel(), "cpu-4")
+    assert report.dcn_bytes_per_step == 0
+    assert "DCN total" not in report.summary()
+
+
+def test_trace_cli_multislice_json():
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_lightning_tpu", "trace",
+         "llama3-8b", "--topo", "2xcpu-4", "--json"],
+        capture_output=True, text=True, timeout=300,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stdout + out.stderr
+    r = json.loads(out.stdout)
+    assert r["topology"]["n_slices"] == 2
+    assert r["mesh"] == {"data": 2, "fsdp": 4}  # HSDP builder placement
+    assert r["dcn_bytes_per_step"] > 0
+    assert not any(f["rule"] == "RLT306" for f in r["findings"])
+
+
+def test_bench_multislice_summary_schema():
+    import bench
+
+    s = bench._multislice_summary()
+    assert "multislice_error" not in s, s
+    ms = s["multislice"]
+    assert ms["topology"] == "2xv5p-64" and ms["n_slices"] == 2
+    assert ms["mesh"] == {"data": 2, "fsdp": 64}
+    assert s["dcn_bytes_per_step"] == ms["dcn_bytes_per_step"] > 0
+    assert ms["ici_bytes_per_step"] > ms["dcn_bytes_per_step"]
+    assert ms["dcn_crossing_flags"] == []
+
+
+def test_bench_gate_dcn_ceiling(tmp_path):
+    import importlib.util
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "bench_gate.py")
+    spec = importlib.util.spec_from_file_location("bench_gate", path)
+    bench_gate = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_gate)
+    ceilings = {"dcn_bytes_per_step": (1000.0, "BENCH_r09.json")}
+    # within the ceiling: pass
+    fails = bench_gate.gate(
+        {"metric": "m", "value": 1.0, "dcn_bytes_per_step": 1000},
+        {}, 0.05, ceilings)
+    assert not fails
+    # grew past it: fail
+    fails = bench_gate.gate(
+        {"metric": "m", "value": 1.0, "dcn_bytes_per_step": 1100},
+        {}, 0.05, ceilings)
+    assert any("dcn_bytes_per_step" in f for f in fails)
+    # dropped the field with no analysis error: fail
+    fails = bench_gate.gate({"metric": "m", "value": 1.0}, {}, 0.05,
+                            ceilings)
+    assert any("dropped the field" in f for f in fails)
+    # dropped WITH the analysis-error escape hatch: waived
+    fails = bench_gate.gate(
+        {"metric": "m", "value": 1.0, "multislice_error": "boom"},
+        {}, 0.05, ceilings)
+    assert not fails
+    # reshard_restore_s bound: over the cap fails on a measured line
+    fails = bench_gate.gate(
+        {"metric": "m", "value": 1.0, "reshard_restore_s": 1e9},
+        {}, 0.05, {})
+    assert any("reshard_restore_s" in f for f in fails)
+
+
+def test_sub_deployment_mesh_never_fabricates_dcn():
+    # review regression: an n_devices override SMALLER than the
+    # topology (a 4-device mesh on a 2x4 deployment) packs into the
+    # fewest slices — no DCN bytes, no RLT306, even for an fsdp mesh
+    from ray_lightning_tpu.analysis.tracecheck import audit_step
+    from ray_lightning_tpu.models.mlp import MLPClassifier
+    from ray_lightning_tpu.parallel.strategy import FSDP
+
+    report = audit_step(
+        MLPClassifier(features=(32,), num_classes=4),
+        FSDP(min_shard_size=8),
+        {"x": np.zeros((8, 8), np.float32),
+         "y": np.zeros((8,), np.int32)},
+        topology="2xcpu-4", n_devices=4)
+    assert report.dcn_bytes_per_step == 0
+    assert not any(f.rule == "RLT306" for f in report.findings)
