@@ -463,7 +463,15 @@ def _serve_summary() -> dict:
     backend touch, so a backend-down skip line still carries the
     serving memory story and tells the recorder what shape the
     measured serving metrics (`decode_tokens_per_s`, `ttft_cold_s`,
-    `ttft_warm_s`, `slot_occupancy` — success lines only) will take."""
+    `ttft_warm_s`, `slot_occupancy` — success lines only) will take.
+
+    ``serve_hbm_bytes_per_replica`` (top-level, EVERY line — ISSUE 11)
+    is the flagship replica's static per-device HBM on the attention
+    path the deployment would actually run (the fused paged-attention
+    kernel when it tiles the shape — it retires the reference lane's
+    dense gathered view). bench_gate CEILING-ratchets it: per-replica
+    serving HBM may only shrink; a ``serving_error`` line waives (an
+    analysis bug is not a regression)."""
     try:
         import jax.numpy as jnp
 
@@ -475,13 +483,20 @@ def _serve_summary() -> dict:
         ecfg = EngineConfig(capacity=8, block_size=16,
                             blocks_per_slot=256, prefill_chunk=256)
         plan = serve_memory_summary(cfg, ecfg)
+        reference = serve_memory_summary(cfg, ecfg, fused=False)
         return {"serving": {
             "schema": ["decode_tokens_per_s", "ttft_cold_s",
-                       "ttft_warm_s", "slot_occupancy"],
+                       "ttft_warm_s", "slot_occupancy",
+                       "serving_attention_path"],
             "engine": "paged-kv continuous-batching (serve/)",
             "source": "static-schema",
             "flagship_plan": plan,
-        }}
+            "attention_path": plan["attention_path"],
+            "gathered_view_retired_bytes":
+                plan["gathered_view_retired_bytes"],
+            "reference_hbm_bytes_per_replica":
+                reference["per_device_bytes"],
+        }, "serve_hbm_bytes_per_replica": plan["per_device_bytes"]}
     except Exception as exc:  # noqa: BLE001 — advisory data only
         return {"serving_error": f"{type(exc).__name__}: {str(exc)[:200]}"}
 
@@ -554,6 +569,10 @@ def _measure_serving(tiny: bool | None = None) -> dict:
         "ttft_warm_s": round(ttft_warm, 4),
         "slot_occupancy": round(sched.slot_occupancy, 4),
         "serving_compile_count": engine.compile_count,
+        # which decode attention the measurement actually exercised —
+        # a decode_tokens_per_s number is only comparable to priors on
+        # the same path (ISSUE 11)
+        "serving_attention_path": engine.attention_path,
     }
 
 

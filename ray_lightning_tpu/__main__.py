@@ -204,9 +204,14 @@ def _print_trace_section(trace: dict) -> None:
 
 def _run_serve_plan(args) -> int:
     """``plan --serve``: the serving replica's HBM story (no optimizer
-    — weights + paged KV pool + the step's dense gathered view +
-    carried logits) with the decode-step tracecheck section. Same exit
-    contract as the training plan: 0 fits, 1 does not, 2 invalid."""
+    — weights + paged KV pool + the attention path's gathered view +
+    carried logits) with the decode-step tracecheck section. The
+    attention path is auto-selected by shape: when the fused
+    paged-attention kernel tiles the config the plan prices the fused
+    path and states the per-replica HBM the kernel retired
+    (docs/SERVING.md "paged-attention kernel"); the decode-step trace
+    audits the SAME path. Same exit contract as the training plan: 0
+    fits, 1 does not, 2 invalid."""
     import jax.numpy as jnp
 
     from ray_lightning_tpu.models.llama import LlamaConfig
@@ -248,9 +253,12 @@ def _run_serve_plan(args) -> int:
 
             topo = topology_for_kind(args.device_kind, 1,
                                      hbm_bytes=args.hbm_bytes)
+            fused = summary["attention_path"] == "paged-pallas"
             report = audit_decode_step(cfg, ecfg, topology=topo,
-                                       label=f"{args.preset} serve")
+                                       label=f"{args.preset} serve",
+                                       fused=fused)
             trace = {
+                "attention_path": summary["attention_path"],
                 "peak_hbm_bytes": report.peak_hbm_bytes,
                 "hbm_budget_bytes": report.hbm_budget_bytes,
                 "findings": [f.to_dict() for f in report.findings],

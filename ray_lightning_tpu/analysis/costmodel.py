@@ -329,3 +329,22 @@ def collective_cost(
     return CollectiveCost(int(ici_wire), ici_time + dcn_time,
                           dcn_bytes=int(dcn_wire),
                           dcn_time_us=dcn_time)
+
+
+def paged_decode_traffic_bytes(pool_bytes: int, gathered_view_bytes: int,
+                               fused: bool) -> int:
+    """Per-tick HBM *traffic* of the serving decode lane's KV movement
+    (docs/SERVING.md "paged-attention kernel") — the bandwidth story
+    behind the capacity numbers `serve_kv_plan_bytes` itemizes.
+
+    Decode is bandwidth-bound: every tick must stream each live slot's
+    K/V once (<= the pool, read). The reference lane additionally
+    WRITES the dense gathered view and READS it back through the
+    model's cache path — the copy is the traffic, not just the HBM.
+    The fused kernel streams the table-named blocks straight through
+    VMEM, so its traffic floor is the single pool read. A conservative
+    per-tick model (the full pool charged even when slots are idle;
+    Q/output/weight bytes excluded — identical on both paths)."""
+    if fused:
+        return int(pool_bytes)
+    return int(pool_bytes + 2 * gathered_view_bytes)

@@ -119,6 +119,17 @@ RULES: Dict[str, Rule] = {r.id: r for r in (
          "slices (hierarchical gradient reduction, docs/ELASTIC.md "
          "'DCN cost model'); re-shape the mesh so the crossing axis "
          "fits inside one slice"),
+    Rule("RLT307", "dense-paged-gather", "warning",
+         "a serving decode step materializes a dense slot-gathered KV "
+         "view of the block-paged pool ([L, capacity, gathered_len, "
+         "Hkv, hd] per tick — ~half the replica's serving HBM and a "
+         "full pool copy of traffic) although the fused paged-attention "
+         "kernel supports the shape: the kernel consumes the pool "
+         "directly through the block tables and retires the copy "
+         "(ops/pallas/paged_attention.py; selected automatically on "
+         "TPU — docs/SERVING.md 'paged-attention kernel'). The "
+         "single-slot prefill gather is sanctioned: it is per-slot "
+         "sized and the kernel covers decode only"),
     Rule("RLT303", "ring-deadlock", "error",
          "a ppermute permutation is not a valid schedule (duplicate "
          "source/destination, out-of-range rank, a full permutation "

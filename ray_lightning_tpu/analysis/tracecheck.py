@@ -204,6 +204,16 @@ class CollectiveEvent:
                 f"{self.source}{who}")
 
 
+def _pallas_kernel_ident(eqn) -> str:
+    """One kernel-fn identity string for a `pallas_call` eqn
+    ("_decode_kernel at .../paged_attention.py:76" style) — the SINGLE
+    extraction both the step auditor and the serve audit's recursive
+    scanner use, so the fingerprint can never drift between them."""
+    ident = (eqn.params.get("name_and_src_info")
+             or eqn.params.get("name") or "pallas")
+    return str(ident)
+
+
 def _fmt_bytes(n: float) -> str:
     for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
         if abs(n) < 1024 or unit == "TiB":
@@ -231,6 +241,10 @@ class TraceReport:
     #: hidden/exposed ICI time, per-scope breakdown. None only when
     #: classification was skipped.
     overlap: Optional[Dict[str, Any]] = None
+    #: pallas kernel identities the walk met (`_pallas_kernel_ident`)
+    #: — the serve audit's "which attention path does this step run"
+    #: evidence (empty on pure-XLA programs)
+    pallas_kernels: List[str] = dataclasses.field(default_factory=list)
 
     @property
     def ici_bytes_per_step(self) -> int:
@@ -372,6 +386,7 @@ class TraceReport:
             "peak_hbm_bytes": self.peak_hbm_bytes,
             "hbm_budget_bytes": self.hbm_budget_bytes,
             "fits": self.fits,
+            "pallas_kernels": list(self.pallas_kernels),
             "findings": [f.to_dict() for f in self.findings],
         }
 
@@ -482,6 +497,13 @@ class _StepAuditor:
         #: the traced program carries the double-buffer fingerprint
         #: (ops.dispatch.OVERLAP_PREFETCH_NAME name equations)
         self.saw_prefetch_marker = False
+        #: every pallas kernel the walk met, by its kernel-fn identity
+        #: (`_pallas_kernel_ident`) — surfaced as
+        #: `TraceReport.pallas_kernels`, where the serve audit/smoke
+        #: read "which attention path does this step run": the same
+        #: fingerprint-over-reimplementation discipline as the flash
+        #: remat tag
+        self.pallas_kernels: List[str] = []
 
     # ---- bookkeeping ----------------------------------------------------
 
@@ -947,10 +969,26 @@ class _StepAuditor:
                 spec[dn.out_spec[0]] = lhs.spec[dn.lhs_spec[0]]
                 set_all([_VarInfo(tuple(spec))])
         elif name == "pallas_call":
-            # opaque kernel, but every kernel in ops/ (flash, rmsnorm)
-            # is LOCAL: no cross-device semantics, and each output has
-            # the layout of the same-shaped input (flash out = q's
-            # sharding, norm out = x's). Unmatched outputs stay unknown.
+            # every kernel in ops/ (flash, rmsnorm, paged_attention) is
+            # LOCAL: no cross-device semantics, and each output has the
+            # layout of the same-shaped input (flash out = q's sharding,
+            # norm out = x's). Unmatched outputs stay unknown. The walk
+            # still RECURSES into the kernel jaxpr — for recognition
+            # (which kernel runs: the serve audit reads
+            # `pallas_kernels`), for its dot_general FLOPs (counted
+            # once per call, an undercount of grid-many trips — the
+            # overlap compute window stays conservative), and so a collective
+            # hiding inside a future kernel is seen — but its internal
+            # buffers are VMEM, not HBM: they contribute NOTHING to the
+            # liveness peak (sub_peak stays 0).
+            if not self._quiet:
+                self.pallas_kernels.append(_pallas_kernel_ident(eqn))
+            closed = eqn.params.get("jaxpr")
+            if closed is not None:
+                try:
+                    self._seed_and_walk(closed, infos, env, mult, manual)
+                except Exception:  # noqa: BLE001 — recognition is
+                    pass           # best-effort, never aborts the audit
             set_all([self._like_shaped_input(v, infos, avals)
                      for v in out])
         elif name == "gather":

@@ -212,7 +212,8 @@ class LlamaBlock(nn.Module):
     mesh: Optional[Any] = None  # jax.sharding.Mesh (static, hashable)
 
     @nn.compact
-    def __call__(self, x, cos, sin, cache=None, pos=None, pad=None):
+    def __call__(self, x, cos, sin, cache=None, pos=None, pad=None,
+                 paged=None):
         """Training/prefill-from-zero when cache is None; with a
         ``cache=(k_cache, v_cache)`` ([B, S_max, Hkv, hd] each) and a
         (traced) ``pos``, runs the KV-cache decode path and returns the
@@ -221,7 +222,19 @@ class LlamaBlock(nn.Module):
         positions shift down by ``pad[b]`` (clamped at 0 for the pad
         rows themselves, whose outputs are discarded) and attention
         masks out the pad columns — a left-padded row decodes exactly
-        like its unpadded prompt (test-pinned)."""
+        like its unpadded prompt (test-pinned).
+
+        ``paged`` (an `ops.attention.PagedDecodeView`, serving engine
+        only) switches the cache path to the block-paged pool: ``cache``
+        is then ONE layer's shared pool ``([n_blocks, P, Hkv, hd])``
+        pair, S must be 1 (one decode token per slot), ``pos`` is a
+        per-slot [B] vector of cache positions, the new K/V token is
+        scattered straight into the pool at the view's (already
+        scratch-redirected) write index, and attention consumes the
+        pool through the per-slot block tables — fused on the pallas
+        path, dense-gathered on the XLA reference path
+        (ops.attention.paged_attention). ``paged=None`` lowers the
+        identical historical program."""
         cfg = self.cfg
         d, hd = cfg.dim, cfg.head_dim
         dense = partial(nn.Dense, use_bias=False, dtype=cfg.dtype,
@@ -277,6 +290,39 @@ class LlamaBlock(nn.Module):
 
                 attn = checkpoint_name(attn, "attn_out")
             new_cache = None
+        elif paged is not None:
+            # paged decode (serve/engine.py fused lane): one token per
+            # slot against the SHARED block pool — no per-slot dense
+            # cache copy exists on the kernel path. ``pos`` is a [B]
+            # vector (per-slot cache position); its RoPE position is
+            # pos - pad for a left-pad-prefilled slot.
+            assert S == 1, "the paged cache path decodes one token/slot"
+            positions = pos[:, None] + jnp.arange(S)[None, :]
+            if pad is not None:
+                positions = jnp.maximum(positions - pad[:, None], 0)
+            q = apply_rope(q, cos, sin, positions=positions)
+            k = apply_rope(k, cos, sin, positions=positions)
+            pk, pv = cache  # [n_blocks, P, Hkv, hd] — one layer's pool
+            # write-then-attend, exactly the dense cache path's
+            # dynamic_update_slice ordering: the token's own K/V is
+            # visible to its query. Idle/prefilling slots arrive
+            # scratch-redirected (write_block 0) — duplicate scratch
+            # writes race, but scratch is masked garbage by contract.
+            pk = pk.at[paged.write_block, paged.write_offset].set(
+                k[:, 0].astype(pk.dtype))
+            pv = pv.at[paged.write_block, paged.write_offset].set(
+                v[:, 0].astype(pv.dtype))
+            from ray_lightning_tpu.ops.attention import paged_attention
+
+            # the view's STATIC use_pallas (the serve engine's
+            # build-time decision) pins the dispatch; absent that,
+            # fall back to the flash-style ambient policy
+            up = (paged.use_pallas if paged.use_pallas is not None
+                  else (None if cfg.use_flash else False))
+            attn = paged_attention(
+                q[:, 0], pk, pv, paged.tables, paged.lengths, pad=pad,
+                use_pallas=up)[:, None]
+            new_cache = (pk, pv)
         else:
             positions = pos + jnp.arange(S)
             if pad is not None:
@@ -333,7 +379,7 @@ class Llama(nn.Module):
 
     @nn.compact
     def __call__(self, tokens: jnp.ndarray, cache=None, pos=None,
-                 pad=None, last_only: bool = False,
+                 pad=None, paged=None, last_only: bool = False,
                  return_hidden: bool = False):
         """Training/eval: ``model(tokens) -> logits``. Decoding:
         ``model(tokens, cache=(k, v), pos=p) -> (logits, new_cache)``
@@ -343,7 +389,10 @@ class Llama(nn.Module):
         the final position through the lm_head (prefill wants one row of
         logits, not [S, vocab]). ``return_hidden`` skips the lm_head and
         returns the final-norm'd [B, S, D] states — the fused-CE loss
-        path projects them chunk-wise (ops/fused_ce.py)."""
+        path projects them chunk-wise (ops/fused_ce.py). ``paged``
+        (serving engine) switches the cache path to the block-paged
+        pool — cache leaves are then [L, n_blocks, P, Hkv, hd] and
+        ``pos`` is a per-slot vector; see `LlamaBlock.__call__`."""
         cfg = self.cfg
         embed = nn.Embed(
             cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
@@ -374,21 +423,23 @@ class Llama(nn.Module):
                     cfg, self.mesh, name="layers")(x, cos, sin)
             else:
                 # cache rides the scan: in over the layer axis, updated
-                # cache collected as the scan output (out_axes=0).
+                # cache collected as the scan output (out_axes=0). The
+                # paged view (block tables / lengths / write indices)
+                # is layer-invariant, so it broadcasts like pos/pad.
                 x, new_cache = scan(
                     block,
                     in_axes=(nn.broadcast, nn.broadcast, 0,
-                             nn.broadcast, nn.broadcast),
+                             nn.broadcast, nn.broadcast, nn.broadcast),
                     out_axes=0,
                 )(cfg, self.mesh, name="layers")(x, cos, sin, cache,
-                                                 pos, pad)
+                                                 pos, pad, paged)
         else:
             caches = []
             for i in range(cfg.n_layers):
                 layer_cache = None if cache is None else jax.tree.map(
                     lambda c, i=i: c[i], cache)
                 x, c = block(cfg, self.mesh, name=f"layer_{i}")(
-                    x, cos, sin, layer_cache, pos, pad)
+                    x, cos, sin, layer_cache, pos, pad, paged)
                 caches.append(c)
             if cache is not None:
                 new_cache = jax.tree.map(

@@ -1,4 +1,5 @@
-"""Attention ops: masked SDPA reference + flash-attention dispatch.
+"""Attention ops: masked SDPA reference + flash-attention dispatch +
+paged decode attention for the serving engine.
 
 The hot op of the flagship model. Three tiers:
   1. `dot_product_attention` — pure jnp reference (materializes the S×S
@@ -7,8 +8,14 @@ The hot op of the flagship model. Three tiers:
      (ray_lightning_tpu.ops.pallas.flash) that never materializes scores;
      O(S) memory, MXU-shaped tiles. Falls back to (1) off-TPU or for
      shapes that don't tile.
-All take [B, S, H, D] (batch, seq, heads, head_dim) and support GQA by
-repeating KV heads (XLA turns the repeat into a broadcast, no HBM copy).
+  3. `paged_attention` — single-token decode attention consuming the
+     serving engine's block-paged KV pool through per-slot block tables
+     (ray_lightning_tpu.ops.pallas.paged_attention); the XLA reference
+     path gathers a dense per-slot view first (identical semantics —
+     that copy is exactly what the kernel retires, docs/SERVING.md).
+(1)/(2) take [B, S, H, D] (batch, seq, heads, head_dim) and support GQA
+by repeating KV heads (XLA turns the repeat into a broadcast, no HBM
+copy); (3) takes one query token per slot, [C, H, D].
 """
 from __future__ import annotations
 
@@ -109,3 +116,118 @@ def flash_attention(
                                       q_offset=q_offset)
     return dot_product_attention(q, k, v, causal=causal, mask=mask,
                                  q_offset=q_offset)
+
+
+# ---- paged decode attention (the serving engine's fused hot op) -----------
+
+
+@jax.tree_util.register_pytree_node_class
+class PagedDecodeView:
+    """The decode lane's runtime view of the block-paged KV pool
+    (serve/kv_cache.py layout; one entry per slot, all int32):
+
+    ``tables [C, M]`` slot -> pool block ids (0 = reserved scratch);
+    ``lengths [C]`` valid cache positions incl. the current token;
+    ``write_block/write_offset [C]`` where THIS tick's K/V token lands
+    (already scratch-redirected for slots not in the decode phase).
+
+    ``use_pallas`` is STATIC pytree aux, not a leaf: it carries the
+    serve engine's build-time dispatch decision through `Llama.apply`
+    and the layer scan into `paged_attention`'s call site, so the
+    compiled attention can never diverge from what
+    `DecodeEngine.attention_path` reports (a trace-time backend
+    re-probe could pick differently if, e.g., the jit traces after a
+    `force_pallas` context has exited). None defers to the ambient
+    dispatch policy."""
+
+    def __init__(self, tables, lengths, write_block, write_offset,
+                 use_pallas: bool | None = None):
+        self.tables = tables
+        self.lengths = lengths
+        self.write_block = write_block
+        self.write_offset = write_offset
+        self.use_pallas = use_pallas
+
+    def tree_flatten(self):
+        return ((self.tables, self.lengths, self.write_block,
+                 self.write_offset), self.use_pallas)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, use_pallas=aux)
+
+
+def paged_attention_reference(
+    q: jnp.ndarray,
+    pool_k: jnp.ndarray,
+    pool_v: jnp.ndarray,
+    tables: jnp.ndarray,
+    lengths: jnp.ndarray,
+    pad: jnp.ndarray | None = None,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """XLA reference with the kernel's exact semantics: gather each
+    slot's blocks into a dense [C, M*P, Hkv, hd] view (the copy the
+    pallas kernel exists to retire), mask `pad <= kv_pos < length`, and
+    run the shared masked-SDPA reference. Scratch-block garbage and
+    table tails are masked to exact softmax zeros, so a longer table
+    cannot perturb the visible reduction (the serving numerics
+    contract, docs/SERVING.md)."""
+    c, h, hd = q.shape
+    _, p, hkv, _ = pool_k.shape
+    m = tables.shape[1]
+    k = pool_k[tables].reshape(c, m * p, hkv, hd)
+    v = pool_v[tables].reshape(c, m * p, hkv, hd)
+    kv_pos = jnp.arange(m * p)[None, :]
+    mask = kv_pos < lengths[:, None]
+    if pad is not None:
+        mask = mask & (kv_pos >= pad[:, None])
+    return dot_product_attention(q[:, None], k, v, causal=False,
+                                 mask=mask, scale=scale)[:, 0]
+
+
+def paged_attention_uses_pallas(q_shape, pool_shape,
+                                use_pallas: bool | None = None) -> bool:
+    """Would `paged_attention` take the pallas kernel for these shapes?
+    ONE predicate shared with the dispatch itself (the
+    `flash_uses_pallas` discipline): the serving engine keys its whole
+    fused-vs-reference decode lane on this at build time, and the
+    audit/plan legs (`serve/audit.py`) key the gathered-view HBM charge
+    on it — so what is charged can never drift from what runs."""
+    from ray_lightning_tpu.ops import dispatch
+
+    if not dispatch.use_pallas(use_pallas):
+        return False
+    from ray_lightning_tpu.ops.pallas.paged_attention import (
+        paged_shapes_supported,
+    )
+
+    return paged_shapes_supported(q_shape, pool_shape)
+
+
+def paged_attention(
+    q: jnp.ndarray,
+    pool_k: jnp.ndarray,
+    pool_v: jnp.ndarray,
+    tables: jnp.ndarray,
+    lengths: jnp.ndarray,
+    pad: jnp.ndarray | None = None,
+    scale: float | None = None,
+    use_pallas: bool | None = None,
+) -> jnp.ndarray:
+    """Decode attention over the block-paged KV pool: q [C, H, hd],
+    pool [n_blocks, P, Hkv, hd], tables [C, M], lengths [C] ->
+    [C, H, hd]. Dispatches to the fused pallas kernel when on TPU (or
+    forced, with interpret mode off-TPU) and the shapes tile; otherwise
+    the gathering XLA reference path — identical semantics, but the
+    dense per-slot view is materialized (and charged by the serve
+    planner)."""
+    if paged_attention_uses_pallas(q.shape, pool_k.shape, use_pallas):
+        from ray_lightning_tpu.ops.pallas.paged_attention import (
+            paged_attention_pallas,
+        )
+
+        return paged_attention_pallas(q, pool_k, pool_v, tables,
+                                      lengths, pad=pad, scale=scale)
+    return paged_attention_reference(q, pool_k, pool_v, tables, lengths,
+                                     pad=pad, scale=scale)

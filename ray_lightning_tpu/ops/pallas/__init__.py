@@ -4,6 +4,11 @@ Kernels run in interpret mode automatically off-TPU (CPU tests), so the
 same code path is exercised by the virtual-device test harness.
 """
 from ray_lightning_tpu.ops.pallas.flash import flash_attention_pallas
+from ray_lightning_tpu.ops.pallas.paged_attention import (
+    paged_attention_pallas,
+    paged_shapes_supported,
+)
 from ray_lightning_tpu.ops.pallas.rmsnorm import rms_norm_pallas
 
-__all__ = ["flash_attention_pallas", "rms_norm_pallas"]
+__all__ = ["flash_attention_pallas", "paged_attention_pallas",
+           "paged_shapes_supported", "rms_norm_pallas"]

@@ -4,27 +4,63 @@ price the paged cache in HBM — zero devices, CPU-host safe.
 Two consumers:
 
   * ``plan --serve`` (the serve-aware plan leg): a serving replica's
-    HBM story — params + paged pool + the dense gathered view the
-    reference step materializes + the carried logits buffer — against
-    the chip budget, plus the jaxpr-level audit of the step itself;
-  * the test/format.sh gates: the decode step must audit CLEAN — the
-    paged-attention gather is an explicit, position-masked table lookup
-    and must never read as an implicit reshard (RLT301), and the step
-    contains no ring collectives to deadlock (RLT303).
+    HBM story — params + paged pool + the attention path's gathered
+    view (the reference lane's capacity-wide dense copy, or the fused
+    kernel's surviving per-group prefill gather) + the carried logits
+    buffer — against the chip budget, plus the jaxpr-level audit of
+    the step itself;
+  * the test/format.sh gates: the decode step must audit CLEAN on BOTH
+    attention paths — the paged gather/kernel must never read as an
+    implicit reshard (RLT301), the step contains no ring collectives to
+    deadlock (RLT303), and a step that still materializes the dense
+    slot-gathered view on a shape the fused kernel supports is flagged
+    **RLT307 dense-paged-gather** (fires on the reference-path
+    flagship trace; absent on the fused path, where the view does not
+    exist; sanctioned on shapes the kernel cannot tile).
 """
 from __future__ import annotations
 
 from typing import Optional
 
-from ray_lightning_tpu.analysis.costmodel import Topology, parse_topology
+from ray_lightning_tpu.analysis.costmodel import (
+    Topology, paged_decode_traffic_bytes, parse_topology,
+)
 from ray_lightning_tpu.serve.engine import EngineConfig, build_step
 from ray_lightning_tpu.serve.kv_cache import serve_kv_plan_bytes
 
 
-def trace_decode_step(model_cfg, engine_cfg: EngineConfig):
+def _shape_fused_available(model_cfg, engine_cfg: EngineConfig) -> bool:
+    """Would the fused kernel tile this (model, engine) shape on a TPU?
+    The PLANNER'S question — shape support only, independent of the
+    host's backend (a CPU host planning a v5p deployment must price the
+    kernel the TPU will run; the runtime dispatch adds the backend gate
+    via `ops.attention.paged_attention_uses_pallas`)."""
+    from ray_lightning_tpu.ops.pallas.paged_attention import (
+        paged_shapes_supported,
+    )
+
+    spec = engine_cfg.pool_spec
+    return paged_shapes_supported(
+        (engine_cfg.capacity, model_cfg.n_heads, model_cfg.head_dim),
+        (spec.n_blocks, spec.block_size, model_cfg.n_kv_heads,
+         model_cfg.head_dim))
+
+
+def trace_decode_step(model_cfg, engine_cfg: EngineConfig,
+                      fused: bool = False):
     """``(closed_jaxpr, meta)`` for the engine's continuous-batching
     step over abstract inputs — the exact program `DecodeEngine` jits,
-    traced with `eval_shape`/`make_jaxpr` so no backend initializes."""
+    traced with `eval_shape`/`make_jaxpr` so no backend initializes.
+
+    ``fused=True`` traces the fused-lane program — the paged-attention
+    kernel is pinned by `build_step`'s baked dispatch decision
+    (`PagedDecodeView.use_pallas`, the same static aux `DecodeEngine`
+    compiles), so the audited program IS the one a fused replica runs
+    regardless of the host's backend; ``fused=False`` traces the
+    reference lane as dispatched on this host. ``meta`` carries
+    ``pallas_kernels`` (kernel identities found anywhere in the trace)
+    and ``dense_paged_gathers`` (top-level capacity-wide gathers of
+    the pool — the RLT307 evidence)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -32,9 +68,10 @@ def trace_decode_step(model_cfg, engine_cfg: EngineConfig):
     from ray_lightning_tpu.models.llama import Llama
 
     model = Llama(model_cfg)
-    step = build_step(model, engine_cfg)
+    step = build_step(model, engine_cfg, fused=fused)
     spec = engine_cfg.pool_spec
-    C, CH = engine_cfg.capacity, engine_cfg.prefill_chunk
+    C, CH, B = engine_cfg.capacity, engine_cfg.prefill_chunk, \
+        engine_cfg.prefill_batch
     s = jax.ShapeDtypeStruct
     a_tok = np.zeros((1, 2), np.int32)
     a_params = jax.eval_shape(
@@ -50,9 +87,19 @@ def trace_decode_step(model_cfg, engine_cfg: EngineConfig):
         s((C,), jnp.int32), s((C,), jnp.bool_),          # pos, decoding
         s((C,), jnp.float32), s((C,), jnp.int32),        # temp, top_k
         s((C, 2), jnp.uint32),                           # rngs
-        s((), jnp.int32), s((CH,), jnp.int32),           # pf slot/tokens
-        s((), jnp.int32), s((), jnp.int32),              # pf pos/last_row
     )
+    if B == 1:
+        args += (
+            s((), jnp.int32), s((CH,), jnp.int32),       # pf slot/tokens
+            s((), jnp.int32), s((), jnp.int32),          # pf pos/last_row
+        )
+    else:
+        args += (
+            s((C,), jnp.int32),                          # slot_pad
+            s((B,), jnp.int32), s((B, CH), jnp.int32),   # pf slots/tokens
+            s((), jnp.int32), s((), jnp.int32),          # pf pos/last_row
+            s((B,), jnp.int32),                          # pf pads
+        )
     closed = jax.make_jaxpr(step)(*args)
     from ray_lightning_tpu.analysis.tracecheck import _dce
 
@@ -62,24 +109,87 @@ def trace_decode_step(model_cfg, engine_cfg: EngineConfig):
     params_bytes = sum(
         int(np.prod(leaf.shape or (1,))) * leaf.dtype.itemsize
         for leaf in _jax.tree.leaves(a_params))
-    return closed, {"args": args, "params_bytes": params_bytes}
+    pool_shape = tuple(pool.shape)
+    return closed, {
+        "args": args,
+        "params_bytes": params_bytes,
+        "fused": fused,
+        "pallas_kernels": _pallas_kernel_names(closed.jaxpr),
+        "dense_paged_gathers": _dense_paged_gathers(
+            closed.jaxpr, pool_shape, C),
+    }
+
+
+def _pallas_kernel_names(jaxpr) -> list:
+    """Kernel identities anywhere in the trace (recursive) — the
+    fingerprint that the fused path actually lowered the kernel. The
+    identity string is `tracecheck._pallas_kernel_ident`, the same
+    extraction the step auditor records into
+    `TraceReport.pallas_kernels`."""
+    from ray_lightning_tpu.analysis.tracecheck import _pallas_kernel_ident
+
+    names = []
+
+    def _walk(j):
+        for eqn in j.eqns:
+            if eqn.primitive.name == "pallas_call":
+                names.append(_pallas_kernel_ident(eqn))
+            for v in eqn.params.values():
+                vals = v if isinstance(v, (list, tuple)) else (v,)
+                for x in vals:
+                    inner = getattr(x, "jaxpr", None)
+                    if inner is not None and hasattr(inner, "eqns"):
+                        _walk(inner)
+
+    _walk(jaxpr)
+    return names
+
+
+def _dense_paged_gathers(jaxpr, pool_shape, capacity: int) -> list:
+    """TOP-LEVEL gathers of a pool-shaped invar whose output is the
+    capacity-wide dense slot view ``[L, C, M, P, Hkv, hd]`` — the
+    decode lane's materialized copy, and RLT307's evidence. Top level
+    only by design: the prefill lane's per-group gather lives inside
+    the step's `lax.cond` and is sanctioned (the kernel covers decode;
+    the prefill copy is group-sized, priced honestly by
+    `serve_kv_plan_bytes`)."""
+    pool_vars = [v for v in jaxpr.invars
+                 if tuple(getattr(v.aval, "shape", ())) == pool_shape]
+    hits = []
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name != "gather" or not eqn.invars:
+            continue
+        if eqn.invars[0] not in pool_vars:
+            continue
+        out_shape = tuple(getattr(eqn.outvars[0].aval, "shape", ()))
+        if (len(out_shape) == 6 and out_shape[0] == pool_shape[0]
+                and out_shape[1] == capacity):
+            hits.append(out_shape)
+    return hits
 
 
 def audit_decode_step(model_cfg, engine_cfg: EngineConfig,
                       topology="v5p-8", reserve_fraction: float = 0.10,
-                      label: str = "serve decode step"):
+                      label: str = "serve decode step",
+                      fused: bool = False):
     """Full tracecheck walk of the decode step: collective schedule
     (none expected on a single-replica step — each replica is one model
-    copy), RLT301/303 findings, and the liveness HBM peak vs the chip
-    budget. Returns a `tracecheck.TraceReport`."""
+    copy), RLT301/303/307 findings, and the liveness HBM peak vs the
+    chip budget. Returns a `tracecheck.TraceReport`.
+
+    RLT307 (dense-paged-gather) fires when the traced step materializes
+    the capacity-wide dense KV view although the fused kernel tiles the
+    shape — i.e. on the reference-path flagship trace. The fused trace
+    has no such gather (the view never exists), and shapes the kernel
+    cannot tile are sanctioned."""
+    from ray_lightning_tpu.analysis.findings import Finding
     from ray_lightning_tpu.analysis.tracecheck import (
-        Finding, TraceReport, _repl, _StepAuditor, _VarInfo,
-        classify_overlap,
+        TraceReport, _repl, _StepAuditor, _VarInfo, classify_overlap,
     )
 
     topo = (topology if isinstance(topology, Topology)
             else parse_topology(topology))
-    closed, meta = trace_decode_step(model_cfg, engine_cfg)
+    closed, meta = trace_decode_step(model_cfg, engine_cfg, fused=fused)
     auditor = _StepAuditor({}, topo, {})
     jaxpr = closed.jaxpr
     env = {}
@@ -89,14 +199,33 @@ def audit_decode_step(model_cfg, engine_cfg: EngineConfig,
     peak = auditor.walk(jaxpr, env, 1, False)
     findings = auditor.findings
     budget = int(topo.hbm_bytes * (1 - reserve_fraction))
+    gib = 1024**3
     if peak > budget:
-        gib = 1024**3
         findings.append(Finding(
             "RLT302",
             f"estimated peak HBM {peak / gib:.2f} GiB/device exceeds "
             f"the {topo.device_kind} budget {budget / gib:.2f} GiB: the "
             "serving step will OOM on this chip — shrink capacity, "
             "blocks_per_slot, or the pool",
+            symbol=label))
+    if meta["dense_paged_gathers"] and _shape_fused_available(
+            model_cfg, engine_cfg):
+        shape = meta["dense_paged_gathers"][0]
+        import math
+
+        view_bytes = (2 * math.prod(shape)
+                      * closed.jaxpr.invars[0].aval.dtype.itemsize
+                      if hasattr(closed.jaxpr.invars[0].aval, "dtype")
+                      else 0)
+        findings.append(Finding(
+            "RLT307",
+            f"the decode lane gathers a dense {list(shape)} slot view "
+            f"of the paged pool every tick (~{view_bytes / gib:.2f} "
+            "GiB of HBM + a full copy of traffic) on a shape the fused "
+            "paged-attention kernel tiles — the kernel consumes the "
+            "pool through the block tables and retires the view "
+            "(selected automatically on TPU; "
+            "docs/SERVING.md 'paged-attention kernel')",
             symbol=label))
     overlap = classify_overlap(auditor.events, auditor.scopes, topo,
                                scheduled=auditor.saw_prefetch_marker)
@@ -111,22 +240,31 @@ def audit_decode_step(model_cfg, engine_cfg: EngineConfig,
         peak_hbm_bytes=peak,
         hbm_budget_bytes=budget,
         label=label,
+        pallas_kernels=auditor.pallas_kernels,
     )
 
 
 def serve_memory_summary(model_cfg, engine_cfg: EngineConfig,
                          device_kind: str = "TPU v5p",
-                         hbm_bytes: Optional[int] = None) -> dict:
+                         hbm_bytes: Optional[int] = None,
+                         fused: Optional[bool] = None) -> dict:
     """The serve-aware plan leg: itemized replica HBM (no optimizer —
-    serving holds weights, the paged pool, the step's dense gathered
-    view, and the carried logits) with a fits verdict against the chip
-    budget. Pure byte math + one eval_shape; no devices."""
+    serving holds weights, the paged pool, the attention path's
+    gathered view, and the carried logits) with a fits verdict against
+    the chip budget. Pure byte math + one eval_shape; no devices.
+
+    ``fused=None`` auto-selects by SHAPE support (the planner prices
+    the path the TPU deployment will run — `_shape_fused_available`);
+    pass False/True to price a specific path (the before/after table
+    in docs/SERVING.md is exactly this pair)."""
     import jax
     import numpy as np
 
     from ray_lightning_tpu.models.llama import Llama
     from ray_lightning_tpu.parallel.plan import hbm_bytes_for_kind
 
+    if fused is None:
+        fused = _shape_fused_available(model_cfg, engine_cfg)
     model = Llama(model_cfg)
     a_params = jax.eval_shape(
         lambda k: model.init(k, np.zeros((1, 2), np.int32))["params"],
@@ -135,14 +273,26 @@ def serve_memory_summary(model_cfg, engine_cfg: EngineConfig,
         int(np.prod(leaf.shape or (1,))) * leaf.dtype.itemsize
         for leaf in jax.tree.leaves(a_params))
     spec = engine_cfg.pool_spec
-    kv = serve_kv_plan_bytes(model_cfg, spec, engine_cfg.capacity)
+    kv = serve_kv_plan_bytes(model_cfg, spec, engine_cfg.capacity,
+                             fused=fused,
+                             prefill_batch=engine_cfg.prefill_batch)
     budget = hbm_bytes if hbm_bytes is not None else \
         hbm_bytes_for_kind(device_kind)
     usable = int(budget * 0.90)
-    total = params_bytes + sum(kv.values())
+    # the retired term is REPORTING (what the kernel bought back), not
+    # a resident buffer — it must never inflate the fits verdict
+    resident = {k: v for k, v in kv.items()
+                if k != "gathered_view_retired_bytes"}
+    total = params_bytes + sum(resident.values())
     return {
         "params_bytes": int(params_bytes),
         **kv,
+        "attention_path": ("paged-pallas" if fused
+                           else "reference-gather"),
+        "decode_kv_traffic_bytes_per_tick": paged_decode_traffic_bytes(
+            kv["pool_bytes"], serve_kv_plan_bytes(
+                model_cfg, spec, engine_cfg.capacity,
+                fused=False)["gathered_view_bytes"], fused),
         "capacity": engine_cfg.capacity,
         "block_size": spec.block_size,
         "n_blocks": spec.n_blocks,
@@ -155,15 +305,29 @@ def serve_memory_summary(model_cfg, engine_cfg: EngineConfig,
 
 def format_serve_summary(s: dict) -> str:
     gib = 1024**3
+    fused = s.get("attention_path") == "paged-pallas"
+    if fused:
+        view_line = (
+            f"  prefill gather   {s['gathered_view_bytes'] / gib:7.2f} "
+            "GiB  (per-group prefill copy; the decode lane's "
+            f"{s['gathered_view_retired_bytes'] / gib:.2f} GiB dense "
+            "view is RETIRED by the fused paged-attention kernel)")
+    else:
+        view_line = (
+            f"  gathered view    {s['gathered_view_bytes'] / gib:7.2f} "
+            "GiB  (reference engine's dense copy; the fused "
+            "paged-attention kernel retires it)")
     lines = [
         f"serve plan: {s['capacity']} slots x {s['max_slot_len']} "
-        f"tokens, pool {s['n_blocks']} x {s['block_size']}-token blocks",
+        f"tokens, pool {s['n_blocks']} x {s['block_size']}-token "
+        f"blocks, attention path: {s.get('attention_path', '?')}",
         f"  params           {s['params_bytes'] / gib:7.2f} GiB",
         f"  kv pool          {s['pool_bytes'] / gib:7.2f} GiB",
-        f"  gathered view    {s['gathered_view_bytes'] / gib:7.2f} GiB"
-        "  (reference engine's dense copy; a fused paged-attention "
-        "kernel retires it)",
+        view_line,
         f"  carried logits   {s['last_logits_bytes'] / gib:7.2f} GiB",
+        f"  decode KV traffic {s['decode_kv_traffic_bytes_per_tick'] / gib:6.2f}"
+        " GiB/tick (cost model: pool read"
+        + (")" if fused else " + dense-view write+read)"),
         f"  total {s['per_device_bytes'] / gib:.2f} GiB vs budget "
         f"{s['budget_bytes'] / gib:.2f} GiB — "
         f"{'fits' if s['fits'] else 'DOES NOT FIT'}",

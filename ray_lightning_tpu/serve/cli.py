@@ -20,7 +20,12 @@ runs; it fails (exit 1) unless ALL of:
     classified, the replica respawns (weights reloaded, step re-warmed
     through the persistent compile cache), the lost streams replay
     bitwise, and the surviving replica's streams are untouched;
-  * the decode step audits clean under tracecheck (no RLT301/RLT303).
+  * the decode step audits clean under tracecheck (no RLT301/RLT303);
+  * the FUSED paged-attention path (`force_pallas` + interpret on a
+    kernel-tiling tiny config): 8 concurrent streams match the
+    reference-path engine token for token, churn still compiles once,
+    the fused decode step audits clean with RLT307 absent and the
+    paged-attention kernel actually present in the trace.
 """
 from __future__ import annotations
 
@@ -55,6 +60,10 @@ def add_serve_parser(sub) -> None:
     p.add_argument("--blocks-per-slot", type=int, default=None,
                    help="default: sized to --seq-budget")
     p.add_argument("--prefill-chunk", type=int, default=32)
+    p.add_argument("--prefill-batch", type=int, default=1,
+                   help="queued prompts admitted per tick through the "
+                        "left-padded batched prefill lane (1 = the "
+                        "historical single-slot lane)")
     p.add_argument("--seq-budget", type=int, default=4096,
                    help="llama3-8b plan: per-slot prompt+generation cap")
     p.add_argument("--run-dir", default=None,
@@ -193,6 +202,10 @@ def run_smoke(args) -> int:
     if any(r in ("RLT301", "RLT303") for r in rules):
         failures.append(f"decode step audit findings: {rules}")
 
+    # ---- leg 4: fused paged-attention path ----------------------------
+    verdict["legs"]["fused_paged"] = _smoke_fused_leg(failures,
+                                                     args.topo)
+
     verdict["ok"] = not failures
     if failures:
         verdict["failures"] = failures
@@ -202,6 +215,100 @@ def run_smoke(args) -> int:
             print(f"serve --smoke FAILED: {f}", file=sys.stderr)
         return 1
     return 0
+
+
+def _smoke_fused_leg(failures: list, topo: str) -> dict:
+    """The fused-path smoke leg: the paged-attention kernel (interpret
+    mode under `force_pallas`) must serve 8 concurrent streams token-
+    for-token equal to the reference-path engine, compile once across
+    churn, and audit clean (RLT307 absent — the dense view is gone).
+
+    Runs on its own kernel-TILING tiny config (head_dim 64, GQA 2:1,
+    8-token blocks): the main legs' tiny model has head_dim 16, which
+    the kernel correctly refuses (`paged_shapes_supported`) — dispatch
+    honesty is part of what this leg proves."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_lightning_tpu.models.llama import Llama, LlamaConfig
+    from ray_lightning_tpu.ops import dispatch
+    from ray_lightning_tpu.serve.audit import audit_decode_step
+    from ray_lightning_tpu.serve.engine import DecodeEngine, EngineConfig
+    from ray_lightning_tpu.serve.scheduler import Request, Scheduler
+
+    cfg = LlamaConfig(vocab_size=256, dim=128, n_layers=2, n_heads=2,
+                      n_kv_heads=1, hidden_dim=256, max_seq_len=128,
+                      remat=False, dtype=jnp.float32)
+    ecfg = EngineConfig(capacity=4, block_size=8, blocks_per_slot=4,
+                        prefill_chunk=4, prefill_batch=2)
+    model = Llama(cfg)
+    prompts = [
+        np.array(jax.random.randint(
+            jax.random.key(300 + i), (3 + (i % 5),), 0,
+            cfg.vocab_size), dtype=np.int32)
+        for i in range(8)
+    ]
+    params = jax.jit(model.init)(jax.random.key(7),
+                                 prompts[0][None])["params"]
+
+    def run(engine):
+        sched = Scheduler(engine, reserve="on_demand")
+        pend = [Request(rid=f"f{i}", prompt=p, max_new_tokens=8,
+                        temperature=0.8 if i % 2 else 0.0,
+                        top_k=5 if i % 2 else None, seed=61 + i)
+                for i, p in enumerate(prompts)]
+        out = {}
+        while sched.busy() or pend:
+            if pend:
+                sched.submit(pend.pop(0))
+            for comp in sched.tick():
+                out[comp.rid] = comp.tokens
+        return out
+
+    ref_engine = DecodeEngine(model, params, ecfg, use_pallas=False)
+    out_ref = run(ref_engine)
+    with dispatch.force_pallas():
+        eng = DecodeEngine(model, params, ecfg)
+        fused_selected = eng.fused
+        out_fused = run(eng) if fused_selected else {}
+    # ONE trace serves both verdicts: the audit's findings (RLT307
+    # absent here <=> no dense decode gather, since the shape tiles)
+    # and the kernel fingerprint the auditor recorded walking it
+    report = audit_decode_step(cfg, ecfg, topology=topo, fused=True,
+                               label="fused smoke decode step")
+    mismatched = [rid for rid in out_ref
+                  if out_fused.get(rid) != out_ref[rid]]
+    rules = sorted({f.rule for f in report.findings})
+    kernel_in_trace = any("paged_attention" in k
+                          for k in report.pallas_kernels)
+    leg = {
+        "fused_selected": fused_selected,
+        "stream_mismatches": mismatched,
+        "compile_count": eng.compile_count,
+        "audit_findings": rules,
+        "kernel_in_trace": kernel_in_trace,
+        "attention_path": eng.attention_path,
+    }
+    if not fused_selected:
+        failures.append("force_pallas did not select the fused paged-"
+                        "attention path for a kernel-tiling shape")
+        return leg
+    if mismatched:
+        failures.append(
+            f"fused-path streams diverge from the reference path: "
+            f"{mismatched}")
+    if eng.compile_count not in (1, -1):
+        failures.append(
+            f"fused-path churn recompiled the step: compile_count="
+            f"{eng.compile_count} (want 1)")
+    if any(r in ("RLT301", "RLT303", "RLT307") for r in rules):
+        failures.append(f"fused decode step audit findings: {rules}")
+    if not kernel_in_trace:
+        failures.append("the paged-attention kernel is absent from the "
+                        "fused trace — the fused lane fell back to the "
+                        "gathering reference op")
+    return leg
 
 
 def _run_example(args) -> int:
@@ -215,7 +322,8 @@ def _run_example(args) -> int:
     bps = args.blocks_per_slot or 8
     ecfg = EngineConfig(capacity=args.slots, block_size=args.block_size,
                         blocks_per_slot=bps,
-                        prefill_chunk=args.prefill_chunk)
+                        prefill_chunk=args.prefill_chunk,
+                        prefill_batch=args.prefill_batch)
     cfg, model, params, prompts, reqs = _tiny_setup(
         args.requests, args.max_new)
     with contextlib.ExitStack() as stack:
@@ -276,21 +384,25 @@ def _run_flagship(args) -> int:
     bps = args.blocks_per_slot or -(-args.seq_budget // args.block_size)
     ecfg = EngineConfig(capacity=args.slots, block_size=args.block_size,
                         blocks_per_slot=bps,
-                        prefill_chunk=max(args.prefill_chunk, 128))
+                        prefill_chunk=max(args.prefill_chunk, 128),
+                        prefill_batch=args.prefill_batch)
     summary = serve_memory_summary(cfg, ecfg)
+    fused = summary["attention_path"] == "paged-pallas"
     report = audit_decode_step(cfg, ecfg, topology=args.topo,
-                               label="llama3-8b serve")
+                               label="llama3-8b serve", fused=fused)
     rules = sorted({f.rule for f in report.findings})
     if getattr(args, "as_json", False):
         print(json.dumps({
             "preset": "llama3-8b", "plan": summary,
             "audit": {"findings": rules,
+                      "attention_path": summary["attention_path"],
                       "peak_hbm_bytes": report.peak_hbm_bytes,
                       "hbm_budget_bytes": report.hbm_budget_bytes},
         }))
     else:
         print(format_serve_summary(summary))
-        print(f"decode-step audit ({args.topo}): "
+        print(f"decode-step audit ({args.topo}, "
+              f"{summary['attention_path']}): "
               f"{'clean' if not rules else rules}, liveness peak "
               f"{report.peak_hbm_bytes / 1024**3:.2f} GiB")
         print("note: static leg — no weights ship with the repo; with "

@@ -33,15 +33,20 @@ per-request token streams are **bitwise-identical** to independent
 single-stream `generate` runs on the XLA reference path (test-pinned;
 the smoke gate re-proves it on every format.sh run).
 
-HBM: the pool (plus one dense gathered view per step) is donated
-through the step along with ``last_logits``, so steady-state serving
-holds one pool, not two (`serve/audit.py` prices all of it in the
-``plan --serve`` leg).
+HBM: the pool is donated through the step along with ``last_logits``,
+so steady-state serving holds one pool, not two. On the **reference
+attention path** the decode lane additionally materializes one dense
+gathered view per step; on the **fused path**
+(`ops.pallas.paged_attention`, selected at build time by
+`ops.attention.paged_attention_uses_pallas` — the flash dispatch
+discipline) the decode lane consumes the pool directly through the
+block tables and that view never exists (`serve/audit.py` prices both
+stories in the ``plan --serve`` leg).
 """
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -67,12 +72,24 @@ class EngineConfig:
     #: prefill chunk width: one admitting slot advances this many prompt
     #: tokens per step (TTFT = ceil(prompt / chunk) steps + one sample)
     prefill_chunk: int = 32
+    #: prefill lane batch (ROADMAP 1d): up to this many queued prompts
+    #: advance TOGETHER each tick through the model's left-padded
+    #: ragged-batch cache path (`generate(prompt_lengths=...)`'s pad
+    #: mechanism): the scheduler admits FIFO groups right-aligned to a
+    #: shared chunk-multiple width, each row's pad columns masked out
+    #: of attention forever. 1 (default) lowers the identical
+    #: historical single-slot program — no pad inputs anywhere.
+    prefill_batch: int = 1
 
     def __post_init__(self):
         if self.capacity < 1:
             raise ValueError("capacity must be >= 1")
         if self.prefill_chunk < 1:
             raise ValueError("prefill_chunk must be >= 1")
+        if not 1 <= self.prefill_batch <= self.capacity:
+            raise ValueError(
+                f"prefill_batch {self.prefill_batch} must be within "
+                f"[1, capacity={self.capacity}]")
         if self.prefill_chunk > self.blocks_per_slot * self.block_size:
             # the scheduler slides the chunk window back to keep the
             # full width inside the slot; a chunk wider than the slot
@@ -119,16 +136,30 @@ def _sample_one(logits, key, temp, top_k):
     return jnp.where(temp == 0.0, greedy, drawn)
 
 
-def build_step(model, cfg: EngineConfig):
+def build_step(model, cfg: EngineConfig, fused: bool = False):
     """The jitted continuous-batching step for ``model`` (a
     `models.llama.Llama` instance) under ``cfg``. Returned uncompiled —
     `DecodeEngine` jits it with the pool/logits donated; `serve.audit`
-    traces it abstractly."""
+    traces it abstractly.
+
+    ``fused`` selects the decode lane at BUILD time (the dispatch
+    decision is static, like a kernel choice — it can never retrace):
+
+      * False — the reference lane: the model's single-token cache path
+        vmapped per slot over a dense gathered view of each slot's
+        blocks. The bitwise anchor against single-stream `generate()`.
+      * True — the fused lane: ONE batched model call whose cache is
+        the pool itself (`models.llama` paged branch +
+        `ops.attention.paged_attention`); the per-slot dense view is
+        never materialized. Pinned to the reference lane within the
+        flash kernel's tolerance discipline (tests/test_paged_attention).
+    """
     mcfg = model.cfg
     spec = cfg.pool_spec
     L, HKV, HD = mcfg.n_layers, mcfg.n_kv_heads, mcfg.head_dim
     C, P, G, CH = cfg.capacity, spec.block_size, spec.gathered_len, \
         cfg.prefill_chunk
+    B = cfg.prefill_batch
 
     def _decode_one(params, tok, kc, vc, pos):
         # the model's OWN single-token cache path ([1, 1] batch), new
@@ -142,27 +173,75 @@ def build_step(model, cfg: EngineConfig):
                                              axis=1)[:, 0]
         return logits[0, 0], k_tok, v_tok
 
-    def step(params, pool_k, pool_v, last_logits, tables, pos, decoding,
-             temp, top_k, rngs, prefill_slot, prefill_tokens,
-             prefill_pos, prefill_last_row):
-        """One engine tick. Donated: pool_k, pool_v, last_logits
-        (positions 1-3 of the signature; `DecodeEngine` owns them).
+    def _decode_one_padded(params, tok, kc, vc, pos, pad):
+        # the left-pad-aware twin (prefill_batch > 1): same program
+        # with the model's pad mask/RoPE shift live (pad == 0 rows
+        # compute bitwise-identically to `_decode_one`)
+        logits, (nk, nv) = model.apply(
+            {"params": params}, tok[None, None],
+            cache=(kc[:, None], vc[:, None]), pos=pos, pad=pad[None])
+        k_tok = jax.lax.dynamic_slice_in_dim(nk[:, 0], pos, 1,
+                                             axis=1)[:, 0]
+        v_tok = jax.lax.dynamic_slice_in_dim(nv[:, 0], pos, 1,
+                                             axis=1)[:, 0]
+        return logits[0, 0], k_tok, v_tok
 
-        Host-owned runtime inputs (plain numpy per call):
-          tables   [C, M] i32   slot -> pool block ids (0 = scratch)
-          pos      [C]    i32   tokens written to each slot's cache
-          decoding [C]    bool  slot is in the decode phase
-          temp     [C]    f32 / top_k [C] i32 / rngs [C, 2] u32
-          prefill_slot  i32     slot taking this step's chunk (-1 none)
-          prefill_tokens [CH] i32 / prefill_pos i32
-          prefill_last_row i32  row of the last REAL prompt token
-                                within this chunk (-1: prompt continues)
+    def _write_index(tables, pos, decoding):
+        # where this tick's K/V token lands; slots not in the decode
+        # phase are redirected to the scratch block
+        bi = jnp.where(
+            decoding,
+            jnp.take_along_axis(tables, (pos // P)[:, None],
+                                axis=1)[:, 0],
+            0)
+        off = jnp.where(decoding, pos % P, 0)
+        return bi, off
 
-        Returns (pool_k, pool_v, last_logits, rngs', emitted [C] i32).
-        ``emitted[s]`` is meaningful only where ``decoding[s]`` — the
-        scheduler masks by its own phase bookkeeping.
-        """
-        # ---- decode lane: sample, then advance every slot ------------
+    def _decode_reference(params, pool_k, pool_v, tables, pos, decoding,
+                          emitted, slot_pad):
+        # one dense gathered view per step — the copy the fused lane
+        # retires (charged by serve_memory_summary on this path only)
+        gk = pool_k[:, tables].reshape(L, C, G, HKV, HD)
+        gv = pool_v[:, tables].reshape(L, C, G, HKV, HD)
+        if slot_pad is None:
+            logits2, k_tok, v_tok = jax.vmap(
+                _decode_one, in_axes=(None, 0, 1, 1, 0),
+                out_axes=(0, 1, 1),
+            )(params, emitted, gk, gv, pos)
+        else:
+            logits2, k_tok, v_tok = jax.vmap(
+                _decode_one_padded, in_axes=(None, 0, 1, 1, 0, 0),
+                out_axes=(0, 1, 1),
+            )(params, emitted, gk, gv, pos, slot_pad)
+        bi, off = _write_index(tables, pos, decoding)
+        pool_k = pool_k.at[:, bi, off].set(k_tok)
+        pool_v = pool_v.at[:, bi, off].set(v_tok)
+        return pool_k, pool_v, logits2
+
+    def _decode_fused(params, pool_k, pool_v, tables, pos, decoding,
+                      emitted, slot_pad):
+        # the fused lane: the pool IS the cache — the model's paged
+        # branch scatters the new K/V at the (scratch-redirected) write
+        # index and `paged_attention` streams block-table-named tiles,
+        # so no [L, C, G, Hkv, hd] copy exists on this path
+        from ray_lightning_tpu.ops.attention import PagedDecodeView
+
+        bi, off = _write_index(tables, pos, decoding)
+        # use_pallas=True (static aux) bakes the build-time decision
+        # into the program: fused=True MEANS the kernel, wherever and
+        # whenever the jit happens to trace (the shape gate already
+        # passed at DecodeEngine init)
+        view = PagedDecodeView(tables=tables, lengths=pos + 1,
+                               write_block=bi, write_offset=off,
+                               use_pallas=True)
+        logits2, (pool_k, pool_v) = model.apply(
+            {"params": params}, emitted[:, None],
+            cache=(pool_k, pool_v), pos=pos, pad=slot_pad, paged=view)
+        return pool_k, pool_v, logits2[:, 0]
+
+    _decode = _decode_fused if fused else _decode_reference
+
+    def _sample(last_logits, decoding, temp, top_k, rngs):
         keys = jax.random.wrap_key_data(rngs)
         split = jax.vmap(jax.random.split)(keys)
         nxt, sub = split[:, 0], split[:, 1]
@@ -171,60 +250,158 @@ def build_step(model, cfg: EngineConfig):
         new_rngs = jnp.where(decoding[:, None],
                              jax.random.key_data(nxt), rngs)
         emitted = jax.vmap(_sample_one)(last_logits, sub, temp, top_k)
-        gk = pool_k[:, tables].reshape(L, C, G, HKV, HD)
-        gv = pool_v[:, tables].reshape(L, C, G, HKV, HD)
-        logits2, k_tok, v_tok = jax.vmap(
-            _decode_one, in_axes=(None, 0, 1, 1, 0), out_axes=(0, 1, 1),
-        )(params, emitted, gk, gv, pos)
-        bi = jnp.where(
-            decoding,
-            jnp.take_along_axis(tables, (pos // P)[:, None],
-                                axis=1)[:, 0],
-            0)
-        off = jnp.where(decoding, pos % P, 0)
-        pool_k = pool_k.at[:, bi, off].set(k_tok)
-        pool_v = pool_v.at[:, bi, off].set(v_tok)
+        return emitted, new_rngs
+
+    if B == 1:
+        def step(params, pool_k, pool_v, last_logits, tables, pos,
+                 decoding, temp, top_k, rngs, prefill_slot,
+                 prefill_tokens, prefill_pos, prefill_last_row):
+            """One engine tick. Donated: pool_k, pool_v, last_logits
+            (positions 1-3 of the signature; `DecodeEngine` owns them).
+
+            Host-owned runtime inputs (plain numpy per call):
+              tables   [C, M] i32   slot -> pool block ids (0 = scratch)
+              pos      [C]    i32   tokens written to each slot's cache
+              decoding [C]    bool  slot is in the decode phase
+              temp     [C]    f32 / top_k [C] i32 / rngs [C, 2] u32
+              prefill_slot  i32     slot taking this step's chunk (-1
+                                    none)
+              prefill_tokens [CH] i32 / prefill_pos i32
+              prefill_last_row i32  row of the last REAL prompt token
+                                    within this chunk (-1: prompt
+                                    continues)
+
+            Returns (pool_k, pool_v, last_logits, rngs', emitted [C]
+            i32). ``emitted[s]`` is meaningful only where
+            ``decoding[s]`` — the scheduler masks by its own phase
+            bookkeeping.
+            """
+            # ---- decode lane: sample, then advance every slot --------
+            emitted, new_rngs = _sample(last_logits, decoding, temp,
+                                        top_k, rngs)
+            pool_k, pool_v, logits2 = _decode(
+                params, pool_k, pool_v, tables, pos, decoding, emitted,
+                None)
+            last_logits = jnp.where(decoding[:, None], logits2,
+                                    last_logits)
+
+            # ---- prefill lane: one chunk for one admitting slot ------
+            def do_prefill(pool_k, pool_v, last_logits):
+                slot = jnp.maximum(prefill_slot, 0)
+                row = tables[slot]
+                kc = pool_k[:, row].reshape(L, 1, G, HKV, HD)
+                vc = pool_v[:, row].reshape(L, 1, G, HKV, HD)
+                logits, (nk, nv) = model.apply(
+                    {"params": params}, prefill_tokens[None],
+                    cache=(kc, vc), pos=prefill_pos)
+                kw = jax.lax.dynamic_slice_in_dim(nk[:, 0], prefill_pos,
+                                                  CH, axis=1)
+                vw = jax.lax.dynamic_slice_in_dim(nv[:, 0], prefill_pos,
+                                                  CH, axis=1)
+                # the full CH-wide write is safe past a partial tail
+                # chunk: positions >= prompt_len hold garbage the decode
+                # lane overwrites before any mask ever exposes them
+                wpos = prefill_pos + jnp.arange(CH)
+                wbi = row[wpos // P]
+                pool_k = pool_k.at[:, wbi, wpos % P].set(kw)
+                pool_v = pool_v.at[:, wbi, wpos % P].set(vw)
+                done_row = logits[0, prefill_last_row]
+                finished = prefill_last_row >= 0
+                last_logits = jnp.where(
+                    (jnp.arange(C) == slot)[:, None] & finished,
+                    done_row[None, :], last_logits)
+                return pool_k, pool_v, last_logits
+
+            pool_k, pool_v, last_logits = jax.lax.cond(
+                prefill_slot >= 0, do_prefill,
+                lambda a, b, c: (a, b, c), pool_k, pool_v, last_logits)
+            return pool_k, pool_v, last_logits, new_rngs, emitted
+
+        return step
+
+    def step(params, pool_k, pool_v, last_logits, tables, pos,
+             decoding, temp, top_k, rngs, slot_pad, prefill_slots,
+             prefill_tokens, prefill_pos, prefill_last_row,
+             prefill_pad):
+        """The batched-prefill twin (prefill_batch > 1). Extra runtime
+        inputs over the single-slot step:
+
+          slot_pad [C] i32      per-slot left pad (0 once unpadded) —
+                                the decode lanes mask pad columns and
+                                shift RoPE exactly like
+                                `generate(prompt_lengths=...)`
+          prefill_slots [B] i32 the head FIFO group's slots (-1 =
+                                vacant row, scratch-redirected)
+          prefill_tokens [B, CH] i32  this chunk of the group's
+                                LEFT-PADDED prompts (right-aligned to
+                                the shared chunk-multiple width)
+          prefill_pos i32       the group's shared cache write offset
+          prefill_last_row i32  in-chunk column of every row's last
+                                real token (-1: prompts continue; the
+                                right-alignment makes it shared)
+          prefill_pad [B] i32   per-row left pad within the group
+        """
+        emitted, new_rngs = _sample(last_logits, decoding, temp, top_k,
+                                    rngs)
+        pool_k, pool_v, logits2 = _decode(
+            params, pool_k, pool_v, tables, pos, decoding, emitted,
+            slot_pad)
         last_logits = jnp.where(decoding[:, None], logits2, last_logits)
 
-        # ---- prefill lane: one chunk for one admitting slot ----------
+        # ---- prefill lane: one chunk for the head FIFO group ---------
         def do_prefill(pool_k, pool_v, last_logits):
-            slot = jnp.maximum(prefill_slot, 0)
-            row = tables[slot]
-            kc = pool_k[:, row].reshape(L, 1, G, HKV, HD)
-            vc = pool_v[:, row].reshape(L, 1, G, HKV, HD)
+            slots = jnp.maximum(prefill_slots, 0)
+            active = prefill_slots >= 0
+            rows = jnp.where(active[:, None], tables[slots], 0)
+            kc = pool_k[:, rows].reshape(L, B, G, HKV, HD)
+            vc = pool_v[:, rows].reshape(L, B, G, HKV, HD)
             logits, (nk, nv) = model.apply(
-                {"params": params}, prefill_tokens[None],
-                cache=(kc, vc), pos=prefill_pos)
-            kw = jax.lax.dynamic_slice_in_dim(nk[:, 0], prefill_pos,
-                                              CH, axis=1)
-            vw = jax.lax.dynamic_slice_in_dim(nv[:, 0], prefill_pos,
-                                              CH, axis=1)
-            # the full CH-wide write is safe past a partial tail chunk:
-            # positions >= prompt_len hold garbage the decode lane
-            # overwrites before any mask ever exposes them
+                {"params": params}, prefill_tokens,
+                cache=(kc, vc), pos=prefill_pos, pad=prefill_pad)
+            kw = jax.lax.dynamic_slice_in_dim(nk, prefill_pos, CH,
+                                              axis=2)
+            vw = jax.lax.dynamic_slice_in_dim(nv, prefill_pos, CH,
+                                              axis=2)
+            # pad columns land real K/V in owned blocks; they are
+            # masked out of every attention forever (the model's pad
+            # contract), so like partial-tail garbage they can never
+            # reach an unmasked reduction
             wpos = prefill_pos + jnp.arange(CH)
-            wbi = row[wpos // P]
-            pool_k = pool_k.at[:, wbi, wpos % P].set(kw)
-            pool_v = pool_v.at[:, wbi, wpos % P].set(vw)
-            done_row = logits[0, prefill_last_row]
-            finished = prefill_last_row >= 0
-            last_logits = jnp.where(
-                (jnp.arange(C) == slot)[:, None] & finished,
-                done_row[None, :], last_logits)
+            wbi = rows[:, wpos // P]
+            woff = jnp.broadcast_to(wpos % P, (B, CH))
+            pool_k = pool_k.at[:, wbi, woff].set(kw)
+            pool_v = pool_v.at[:, wbi, woff].set(vw)
+            done = active & (prefill_last_row >= 0)
+            done_rows = logits[:, prefill_last_row]      # [B, V]
+            # scatter each finished row's logits into its slot via a
+            # one-hot contraction: vacant rows map to slot -1 (never
+            # matches), and <= 1 row per slot makes the sum exact
+            sel = (jnp.arange(C)[:, None]
+                   == jnp.where(done, slots, -1)[None, :])
+            contrib = sel.astype(done_rows.dtype) @ done_rows
+            last_logits = jnp.where(sel.any(axis=1)[:, None], contrib,
+                                    last_logits)
             return pool_k, pool_v, last_logits
 
         pool_k, pool_v, last_logits = jax.lax.cond(
-            prefill_slot >= 0, do_prefill,
+            jnp.any(prefill_slots >= 0), do_prefill,
             lambda a, b, c: (a, b, c), pool_k, pool_v, last_logits)
         return pool_k, pool_v, last_logits, new_rngs, emitted
 
     return step
 
 
-#: the step's no-prefill sentinel tuple: (slot, tokens, pos, last_row)
 def idle_prefill(cfg: EngineConfig):
-    return (np.int32(-1), np.zeros(cfg.prefill_chunk, np.int32),
-            np.int32(0), np.int32(-1))
+    """The step's no-prefill sentinel: (slot, tokens, pos, last_row)
+    for the single-slot lane, (slots, tokens, pos, last_row, pads) for
+    the batched lane."""
+    if cfg.prefill_batch == 1:
+        return (np.int32(-1), np.zeros(cfg.prefill_chunk, np.int32),
+                np.int32(0), np.int32(-1))
+    B = cfg.prefill_batch
+    return (np.full(B, -1, np.int32),
+            np.zeros((B, cfg.prefill_chunk), np.int32),
+            np.int32(0), np.int32(-1), np.zeros(B, np.int32))
 
 
 class DecodeEngine:
@@ -236,13 +413,33 @@ class DecodeEngine:
     """
 
     def __init__(self, model, params, cfg: EngineConfig,
-                 max_seq_len_check: bool = True):
+                 max_seq_len_check: bool = True,
+                 use_pallas: Optional[bool] = None):
         if max_seq_len_check and cfg.max_slot_len > model.cfg.max_seq_len:
             raise ValueError(
                 f"engine max_slot_len {cfg.max_slot_len} exceeds the "
                 f"model's max_seq_len {model.cfg.max_seq_len} — RoPE "
                 "tables would be read out of range")
         self.model = model
+        # the attention-path decision is made ONCE, at build time, by
+        # the same predicate the op's dispatch uses (flash discipline:
+        # ops.attention.paged_attention_uses_pallas) — on TPU (or under
+        # force_pallas/RLT_PALLAS with interpret mode) and a tiling
+        # shape, the decode lane is the fused paged-attention kernel
+        # and the dense gathered view is never built; otherwise the
+        # reference lane, the bitwise anchor against generate().
+        from ray_lightning_tpu.ops.attention import (
+            paged_attention_uses_pallas,
+        )
+
+        spec = cfg.pool_spec
+        if use_pallas is None and not model.cfg.use_flash:
+            use_pallas = False  # reference-forced model config
+        self.fused = paged_attention_uses_pallas(
+            (cfg.capacity, model.cfg.n_heads, model.cfg.head_dim),
+            (spec.n_blocks, spec.block_size, model.cfg.n_kv_heads,
+             model.cfg.head_dim),
+            use_pallas)
         # canonicalize the weights' placement: trainer-produced params
         # arrive committed to a NamedSharding over the training mesh,
         # and a step closed over those emits NamedSharding outputs —
@@ -257,7 +454,7 @@ class DecodeEngine:
         self.params = jax.device_put(params, jax.devices()[0])
         self.cfg = cfg
         self.spec = cfg.pool_spec
-        self._step = jax.jit(build_step(model, cfg),
+        self._step = jax.jit(build_step(model, cfg, fused=self.fused),
                              donate_argnums=(1, 2, 3))
         # COMMIT the device-resident buffers to the same device as the
         # weights: a fresh jnp.zeros is uncommitted, but the step's
@@ -275,6 +472,12 @@ class DecodeEngine:
         self.steps = 0
 
     # ---- compile accounting ---------------------------------------------
+
+    @property
+    def attention_path(self) -> str:
+        """Which decode attention ran for this replica's lifetime —
+        surfaced by the bench serving leg and the smoke verdicts."""
+        return "paged-pallas" if self.fused else "reference-gather"
 
     @property
     def compile_count(self) -> int:
@@ -300,20 +503,34 @@ class DecodeEngine:
             top_k=np.zeros(C, np.int32),
             rngs=np.zeros((C, 2), np.uint32),
             prefill=idle_prefill(self.cfg),
+            pad=np.zeros(C, np.int32),
         )
 
     # ---- the tick --------------------------------------------------------
 
-    def tick(self, tables, pos, decoding, temp, top_k, rngs, prefill):
+    def tick(self, tables, pos, decoding, temp, top_k, rngs, prefill,
+             pad=None):
         """Run one step; returns (emitted [C] i32 np, rngs' [C, 2] u32
-        np). The donated device buffers are swapped internally."""
-        pslot, ptoks, ppos, plast = prefill
-        (self.pool_k, self.pool_v, self.last_logits, new_rngs,
-         emitted) = self._step(
+        np). The donated device buffers are swapped internally. ``pad``
+        ([C] i32 per-slot left pad) exists only on the batched-prefill
+        program (prefill_batch > 1) and is ignored otherwise — the
+        single-slot program is the historical one, with no pad inputs."""
+        common = (
             self.params, self.pool_k, self.pool_v, self.last_logits,
             jnp.asarray(tables), jnp.asarray(pos), jnp.asarray(decoding),
-            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(rngs),
-            jnp.asarray(pslot), jnp.asarray(ptoks), jnp.asarray(ppos),
-            jnp.asarray(plast))
+            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(rngs))
+        if self.cfg.prefill_batch == 1:
+            pslot, ptoks, ppos, plast = prefill
+            args = common + (jnp.asarray(pslot), jnp.asarray(ptoks),
+                             jnp.asarray(ppos), jnp.asarray(plast))
+        else:
+            if pad is None:
+                pad = np.zeros(self.cfg.capacity, np.int32)
+            pslot, ptoks, ppos, plast, ppad = prefill
+            args = common + (jnp.asarray(pad), jnp.asarray(pslot),
+                             jnp.asarray(ptoks), jnp.asarray(ppos),
+                             jnp.asarray(plast), jnp.asarray(ppad))
+        (self.pool_k, self.pool_v, self.last_logits, new_rngs,
+         emitted) = self._step(*args)
         self.steps += 1
         return np.array(emitted), np.array(new_rngs)

@@ -93,25 +93,43 @@ def pool_bytes(cfg, spec: PagedPoolSpec) -> int:
 
 
 def gathered_view_bytes(cfg, spec: PagedPoolSpec, capacity: int) -> int:
-    """HBM of the dense per-slot gathered view the step materializes
-    (k + v): ``[L, capacity, gathered_len, Hkv, hd]``. The reference
-    engine pays this copy for correctness-first paged semantics; a
-    production paged-attention kernel fuses gather+attend and drops it
-    (docs/SERVING.md "cost model") — until then the planner charges it."""
+    """HBM of the dense per-slot gathered view the REFERENCE decode
+    lane materializes (k + v): ``[L, capacity, gathered_len, Hkv, hd]``.
+    The reference engine pays this copy for correctness-first paged
+    semantics; the fused paged-attention kernel
+    (ops/pallas/paged_attention.py) consumes the pool through the block
+    tables and this term vanishes (docs/SERVING.md "paged-attention
+    kernel") — the planner charges whichever path the engine would
+    select (`serve_kv_plan_bytes(fused=...)`)."""
     per = (cfg.n_layers * capacity * spec.gathered_len
            * cfg.n_kv_heads * cfg.head_dim)
     return 2 * per * jnp.dtype(cfg.dtype).itemsize
 
 
-def serve_kv_plan_bytes(cfg, spec: PagedPoolSpec, capacity: int) -> dict:
+def serve_kv_plan_bytes(cfg, spec: PagedPoolSpec, capacity: int,
+                        fused: bool = False,
+                        prefill_batch: int = 1) -> dict:
     """The serving cache's HBM story for the ``plan --serve`` leg:
     itemized pool + gathered view + the per-slot logits buffer the
-    engine keeps device-resident between steps."""
+    engine keeps device-resident between steps.
+
+    ``fused`` selects the attention path being priced. On the fused
+    path the decode lane's capacity-wide dense view is RETIRED — what
+    survives is the prefill lane's per-group gather
+    (``[L, prefill_batch, gathered_len, Hkv, hd]``, the kernel covers
+    decode only), and the retired bytes are itemized so `plan --serve`
+    can state the per-replica HBM the kernel bought back."""
     logits = capacity * cfg.vocab_size * 4  # f32 last_logits
+    dense = int(gathered_view_bytes(cfg, spec, capacity))
+    if fused:
+        view = int(gathered_view_bytes(cfg, spec,
+                                       min(prefill_batch, capacity)))
+    else:
+        view = dense
     return {
         "pool_bytes": int(pool_bytes(cfg, spec)),
-        "gathered_view_bytes": int(gathered_view_bytes(cfg, spec,
-                                                       capacity)),
+        "gathered_view_bytes": view,
+        "gathered_view_retired_bytes": dense - view,
         "last_logits_bytes": int(logits),
     }
 

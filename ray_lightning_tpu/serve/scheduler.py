@@ -84,6 +84,23 @@ class _Slot:
         self.seq = seq
 
 
+@dataclasses.dataclass
+class _PrefillGroup:
+    """One FIFO prefill unit. Single-slot engines (prefill_batch == 1)
+    run groups of one with ``width`` = the raw prompt length (the
+    historical slide-back chunk discipline). Batched engines admit up
+    to ``prefill_batch`` requests into one group, every row RIGHT-
+    ALIGNED to the shared chunk-multiple ``width`` (the model's
+    left-pad cache path — `generate(prompt_lengths=...)`): rows advance
+    in lockstep at the shared write offset ``next`` and all finish on
+    the same chunk, where the last real token of every row sits in the
+    same in-chunk column."""
+
+    slots: List[int]
+    width: int
+    next: int = 0
+
+
 def _key_data(seed: int) -> np.ndarray:
     return np.array(jax.random.key_data(jax.random.key(seed)),
                     np.uint32)
@@ -118,10 +135,14 @@ class Scheduler:
         self.temp = np.zeros(C, np.float32)
         self.top_k = np.zeros(C, np.int32)
         self.rngs = np.zeros((C, 2), np.uint32)
+        #: per-slot left pad (batched prefill admits left-padded rows;
+        #: 0 everywhere on single-slot engines) — the decode lanes mask
+        #: pad columns exactly like generate(prompt_lengths=...)
+        self.pad = np.zeros(C, np.int32)
         self.slots: Dict[int, _Slot] = {}
         self.free_slots: List[int] = list(range(C))
         self.queue: Deque[Tuple[Request, int]] = deque()  # (req, preempts)
-        self.prefill_order: Deque[int] = deque()          # slot ids
+        self.prefill_groups: Deque[_PrefillGroup] = deque()  # FIFO
         self.completions: List[Completion] = []
         #: (rid, token) pairs emitted by the MOST RECENT tick — the
         #: driver's streaming hook
@@ -141,9 +162,17 @@ class Scheduler:
 
     def submit(self, req: Request) -> None:
         total = req.prompt.size + req.max_new_tokens
+        padded = ""
+        if self.cfg.prefill_batch > 1:
+            # batched prefill right-aligns the prompt to a chunk
+            # multiple even when the request is admitted alone — the
+            # admission-time span must cover that pad
+            ch = self.cfg.prefill_chunk
+            total = -(-req.prompt.size // ch) * ch + req.max_new_tokens
+            padded = " (chunk-padded)"
         if total > self.cfg.max_slot_len:
             raise ValueError(
-                f"request {req.rid}: prompt {req.prompt.size} + "
+                f"request {req.rid}: prompt {req.prompt.size}{padded} + "
                 f"max_new_tokens {req.max_new_tokens} exceeds the "
                 f"engine's max_slot_len {self.cfg.max_slot_len}")
         if -(-total // self.spec.block_size) > self.spec.n_blocks - 1:
@@ -162,40 +191,88 @@ class Scheduler:
 
     # ---- internals -------------------------------------------------------
 
-    def _blocks_needed_at_admit(self, req: Request) -> int:
+    def _blocks_needed_at_admit(self, req: Request,
+                                width: Optional[int] = None) -> int:
+        """``width`` is the (padded) prefill width the slot will hold —
+        the raw prompt length on single-slot engines."""
+        if width is None:
+            width = req.prompt.size
         if self.reserve == "worst_case":
-            span = req.prompt.size + req.max_new_tokens
+            span = width + req.max_new_tokens
+        elif self.cfg.prefill_batch > 1:
+            # batched prefill writes exactly [0, width) — width is
+            # already a chunk multiple; growth per decode boundary
+            span = width
         else:
             # prefill writes full chunks: cover the prompt rounded up
             # to the chunk width (tail-chunk garbage lands in owned
             # blocks), growth happens per decode block boundary
             ch = self.cfg.prefill_chunk
-            span = min(-(-req.prompt.size // ch) * ch,
-                       self.cfg.max_slot_len)
+            span = min(-(-width // ch) * ch, self.cfg.max_slot_len)
         return -(-span // self.spec.block_size)
 
+    def _admit_one(self, width: int) -> Optional[int]:
+        """Admit the queue head into a free slot with blocks reserved
+        for ``width`` prefill positions. Returns the slot id, or None
+        when the pool is short (FIFO holds)."""
+        req, preempts = self.queue[0]
+        blocks = self.alloc.alloc(self._blocks_needed_at_admit(req,
+                                                               width))
+        if blocks is None:
+            return None  # pool short: keep FIFO order, retry next tick
+        self.queue.popleft()
+        s = self.free_slots.pop(0)
+        self._seq += 1
+        slot = _Slot(req, blocks, preempts, self._seq)
+        self.slots[s] = slot
+        self.tables[s, :] = 0
+        self.tables[s, :len(blocks)] = blocks
+        self.pos[s] = 0
+        self.decoding[s] = False
+        self.pad[s] = width - req.prompt.size
+        self.temp[s] = req.temperature
+        self.top_k[s] = req.top_k or 0
+        self.rngs[s] = _key_data(req.seed)
+        self._queue_wait[req.rid] = (
+            slot.admitted_at - req.arrival if req.arrival else 0.0)
+        return s
+
     def _admit(self) -> None:
+        if self.cfg.prefill_batch == 1:
+            while self.queue and self.free_slots:
+                s = self._admit_one(self.queue[0][0].prompt.size)
+                if s is None:
+                    return
+                self.prefill_groups.append(
+                    _PrefillGroup([s], self.slots[s].req.prompt.size))
+            return
+        # batched admission: FIFO groups of up to prefill_batch
+        # requests, every member right-aligned to the group width W =
+        # the HEAD request's chunk-rounded prompt length. A longer
+        # prompt at the queue head ends the group and heads the next
+        # one (W never grows after member 1, so earlier members' block
+        # reservations stay valid) — no request is ever skipped past.
+        ch = self.cfg.prefill_chunk
         while self.queue and self.free_slots:
-            req, preempts = self.queue[0]
-            need = self._blocks_needed_at_admit(req)
-            blocks = self.alloc.alloc(need)
-            if blocks is None:
-                return  # pool short: keep FIFO order, retry next tick
-            self.queue.popleft()
-            s = self.free_slots.pop(0)
-            self._seq += 1
-            slot = _Slot(req, blocks, preempts, self._seq)
-            self.slots[s] = slot
-            self.tables[s, :] = 0
-            self.tables[s, :len(blocks)] = blocks
-            self.pos[s] = 0
-            self.decoding[s] = False
-            self.temp[s] = req.temperature
-            self.top_k[s] = req.top_k or 0
-            self.rngs[s] = _key_data(req.seed)
-            self._queue_wait[req.rid] = (
-                slot.admitted_at - req.arrival if req.arrival else 0.0)
-            self.prefill_order.append(s)
+            group: List[int] = []
+            width = 0
+            while (self.queue and self.free_slots
+                   and len(group) < self.cfg.prefill_batch):
+                req, _ = self.queue[0]
+                solo_w = -(-req.prompt.size // ch) * ch
+                if not group:
+                    width = solo_w
+                elif (solo_w > width
+                      or width + req.max_new_tokens
+                      > self.cfg.max_slot_len):
+                    break  # heads the next group instead
+                s = self._admit_one(width)
+                if s is None:
+                    break  # pool short
+                group.append(s)
+            if not group:
+                return
+            self.prefill_groups.append(_PrefillGroup(group, width))
 
     def _grow(self, s: int, slot: _Slot) -> bool:
         """Ensure the block covering ``pos`` exists before a decode
@@ -220,8 +297,13 @@ class Scheduler:
         self.tables[s, :] = 0
         self.decoding[s] = False
         self.pos[s] = 0
-        if s in self.prefill_order:
-            self.prefill_order.remove(s)
+        self.pad[s] = 0
+        for g in list(self.prefill_groups):
+            if s in g.slots:
+                g.slots.remove(s)
+                if not g.slots:  # group emptied mid-prefill
+                    self.prefill_groups.remove(g)
+                break
         self.free_slots.append(s)
         self.queue.appendleft((slot.req, slot.preempted + 1))
 
@@ -242,6 +324,7 @@ class Scheduler:
         self.tables[s, :] = 0
         self.decoding[s] = False
         self.pos[s] = 0
+        self.pad[s] = 0
         self.free_slots.append(s)
         self.completions.append(comp)
         return comp
@@ -287,15 +370,15 @@ class Scheduler:
                         f"request {me.req.rid} cannot grow with the "
                         "pool to itself — engine pool is smaller than "
                         "one request's span")
-        # one prefill chunk, FIFO over admitted-but-not-decoding slots
+        # one prefill chunk, FIFO over admitted-but-not-decoding groups
         prefill = idle_prefill(self.cfg)
-        pf_slot = None
-        if self.prefill_order:
-            pf_slot = self.prefill_order[0]
+        pf_group = self.prefill_groups[0] if self.prefill_groups else None
+        ch = self.cfg.prefill_chunk
+        if pf_group is not None and self.cfg.prefill_batch == 1:
+            pf_slot = pf_group.slots[0]
             slot = self.slots[pf_slot]
             ptoks = slot.req.prompt
             ppos = slot.prefill_next
-            ch = self.cfg.prefill_chunk
             chunk_len = min(ch, ptoks.size - ppos)
             # the engine writes the FULL ch-wide window: slide the
             # window start back so it never crosses the slot end —
@@ -312,22 +395,56 @@ class Scheduler:
             last_row = (ptoks.size - 1 - start) if finished else -1
             prefill = (np.int32(pf_slot), chunk, np.int32(start),
                        np.int32(last_row))
+        elif pf_group is not None:
+            # batched lane: the head group advances one shared chunk;
+            # every row's LEFT-padded prompt is right-aligned to the
+            # group width, so the final chunk's last real token sits in
+            # the same column for every row (no window sliding: the
+            # width is a chunk multiple by construction)
+            B = self.cfg.prefill_batch
+            start = pf_group.next
+            toks = np.zeros((B, ch), np.int32)
+            slots_arr = np.full(B, -1, np.int32)
+            pads = np.zeros(B, np.int32)
+            for r, s in enumerate(pf_group.slots):
+                req = self.slots[s].req
+                pad = int(self.pad[s])
+                slots_arr[r] = s
+                pads[r] = pad
+                # padded row: pad zeros then the prompt; this chunk is
+                # padded_row[start : start + ch]
+                p = start - pad + np.arange(ch)
+                valid = (p >= 0) & (p < req.prompt.size)
+                toks[r, valid] = req.prompt[p[valid]]
+            finished = start + ch >= pf_group.width
+            last_row = (pf_group.width - 1 - start) if finished else -1
+            prefill = (slots_arr, toks, np.int32(start),
+                       np.int32(last_row), pads)
         was_decoding = self.decoding.copy()
         emitted, self.rngs = self.engine.tick(
             self.tables, self.pos, self.decoding, self.temp, self.top_k,
-            self.rngs, prefill)
+            self.rngs, prefill,
+            pad=self.pad if self.cfg.prefill_batch > 1 else None)
         self._occupancy_sum += float(was_decoding.mean())
         self._ticks += 1
         # prefill accounting
-        if pf_slot is not None:
+        if pf_group is not None and self.cfg.prefill_batch == 1:
+            pf_slot = pf_group.slots[0]
             slot = self.slots[pf_slot]
-            chunk_len = min(self.cfg.prefill_chunk,
-                            slot.req.prompt.size - slot.prefill_next)
+            chunk_len = min(ch, slot.req.prompt.size - slot.prefill_next)
             slot.prefill_next += chunk_len
             self.pos[pf_slot] += chunk_len
             if slot.prefill_next >= slot.req.prompt.size:
-                self.prefill_order.popleft()
+                self.prefill_groups.popleft()
                 self.decoding[pf_slot] = True
+        elif pf_group is not None:
+            pf_group.next += ch
+            for s in pf_group.slots:
+                self.pos[s] += ch  # cache positions incl. pad columns
+            if pf_group.next >= pf_group.width:
+                self.prefill_groups.popleft()
+                for s in pf_group.slots:
+                    self.decoding[s] = True
         # decode accounting
         done: List[Completion] = []
         self.last_emissions = []

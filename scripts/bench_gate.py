@@ -72,9 +72,34 @@ STATIC = {"overlap_hidden_fraction"}
 #: value must stay <= the best (minimum) prior * (1 + tolerance).
 #: dcn_bytes_per_step is the static 2xv5p-64 trace's inter-slice bytes
 #: (ISSUE 9): DCN is the slow tier, so its per-step traffic may only
-#: shrink. Static class: ratchets on skip lines too; a line carrying
-#: multislice_error instead waives (analysis bug != regression).
-CEILING = {"dcn_bytes_per_step": "dcn_bytes_per_step"}
+#: shrink. serve_hbm_bytes_per_replica is the flagship serving
+#: replica's static per-device HBM on its auto-selected attention path
+#: (ISSUE 11): the fused paged-attention kernel retired the dense
+#: gathered view, and per-replica serving HBM may only shrink from
+#: there. Static class: ratchets on skip lines too; a line carrying
+#: the metric's waiver error field instead waives (analysis bug !=
+#: regression).
+CEILING = {"dcn_bytes_per_step": "dcn_bytes_per_step",
+           "serve_hbm_bytes_per_replica": "serve_hbm_bytes_per_replica"}
+
+#: ceiling metric -> error fields whose presence waives an ABSENT
+#: value (the analysis that computes the static metric died and said
+#: so); a present value always ratchets
+CEILING_WAIVERS = {
+    "dcn_bytes_per_step": ("multislice_error", "tracecheck_error"),
+    "serve_hbm_bytes_per_replica": ("serving_error",
+                                    "tracecheck_error"),
+}
+
+#: ceiling metric -> short rationale for the failure message
+CEILING_WHY = {
+    "dcn_bytes_per_step": ("DCN is the slow tier; its per-step "
+                           "traffic may only shrink"),
+    "serve_hbm_bytes_per_replica": (
+        "per-replica serving HBM may only shrink — the fused "
+        "paged-attention kernel retired the dense gathered view and "
+        "nothing may quietly grow it back"),
+}
 
 #: metric -> max allowed value on a measured (non-skip) line; absent or
 #: null waives (bench.py reports null when the probe itself failed) —
@@ -232,10 +257,10 @@ def gate(fresh: dict, best: dict, tolerance: float,
         key = CEILING[name]
         v = fresh.get(key)
         if v is None:
-            if "multislice_error" in fresh or "tracecheck_error" in fresh:
-                # the static trace died — an analysis failure is
-                # reported as its own error field, never as a deleted
-                # metric (same contract as the STATIC ratchet above)
+            if any(w in fresh for w in CEILING_WAIVERS[name]):
+                # the static analysis died — a failure is reported as
+                # its own error field, never as a deleted metric (same
+                # contract as the STATIC ratchet above)
                 continue
             failures.append(
                 f"{name}: prior rounds track it ({prior:g} in {source}) "
@@ -250,8 +275,8 @@ def gate(fresh: dict, best: dict, tolerance: float,
         if v > cap:
             failures.append(
                 f"{name}: {v:g} grew past {cap:g} (best prior {prior:g} "
-                f"in {source}, tolerance {tolerance:.0%}) — DCN is the "
-                "slow tier; its per-step traffic may only shrink")
+                f"in {source}, tolerance {tolerance:.0%}) — "
+                f"{CEILING_WHY[name]}")
     for key, bound in BOUNDED.items():
         if skipped:
             continue  # bounds apply to measured lines only
