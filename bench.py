@@ -486,8 +486,8 @@ def _serve_summary() -> dict:
         reference = serve_memory_summary(cfg, ecfg, fused=False)
         return {"serving": {
             "schema": ["decode_tokens_per_s", "ttft_cold_s",
-                       "ttft_warm_s", "slot_occupancy",
-                       "serving_attention_path"],
+                       "ttft_warm_s", "ttft_p99_s", "slot_occupancy",
+                       "serving_attention_path", "serve_metrics"],
             "engine": "paged-kv continuous-batching (serve/)",
             "source": "static-schema",
             "flagship_plan": plan,
@@ -532,14 +532,16 @@ def _measure_serving(tiny: bool | None = None) -> dict:
         ecfg = EngineConfig(capacity=8, block_size=16,
                             blocks_per_slot=64, prefill_chunk=128)
         prompt_len, max_new, n_requests = 128, 64, 16
+    from ray_lightning_tpu.telemetry.metrics import MetricsRegistry
+
     model = Llama(cfg)
     prompt = np.asarray(jax.random.randint(
         jax.random.key(0), (1, prompt_len), 0, cfg.vocab_size),
         dtype=np.int32)
     params = jax.jit(model.init)(jax.random.key(1), prompt)["params"]
 
-    def first_token_wall(engine) -> float:
-        sched = Scheduler(engine)
+    def first_token_wall(engine, metrics=None) -> float:
+        sched = Scheduler(engine, metrics=metrics)
         sched.submit(Request(rid="ttft", prompt=prompt[0],
                              max_new_tokens=1))
         t0 = _time.perf_counter()
@@ -547,13 +549,18 @@ def _measure_serving(tiny: bool | None = None) -> dict:
             sched.tick()
         return _time.perf_counter() - t0
 
+    # in-memory live-metrics registry (telemetry/metrics.py) for the
+    # WARM legs only — the cold probe's compile must not pollute the
+    # steady-state SLO histogram the ttft_p99_s bound gates
+    reg = MetricsRegistry()
     # TTFT cold: fresh engine, no warmup — the compile is the latency
     engine = DecodeEngine(model, params, ecfg)
     ttft_cold = first_token_wall(engine)
     # TTFT warm: the same compiled engine, a fresh request
-    ttft_warm = first_token_wall(engine)
+    ttft_warm = first_token_wall(engine, metrics=reg)
     # steady-state decode throughput, slots saturated
-    sched = Scheduler(engine)
+    engine.metrics = reg
+    sched = Scheduler(engine, metrics=reg)
     for i in range(n_requests):
         sched.submit(Request(rid=f"r{i}", prompt=prompt[0],
                              max_new_tokens=max_new, seed=i))
@@ -563,16 +570,36 @@ def _measure_serving(tiny: bool | None = None) -> dict:
         sched.tick()
         n_tokens += len(sched.last_emissions)
     wall = _time.perf_counter() - t0
+    # the serve_metrics rollup: queue-depth stats from the per-tick
+    # ring, event counters, and the warm TTFT p99 from the mergeable
+    # histogram buckets (the SLO number bench_gate upper-bounds;
+    # env-overridable, waived on skip/null like ttft_warm_s)
+    counters = reg.counters()
+    qd = sorted(float((s.get("g") or {}).get("queue_depth", 0.0))
+                for s in reg.ring())
+    ttft_hist = reg.histogram("ttft_s")
+    ttft_p99 = ttft_hist.quantile(0.99) if ttft_hist else None
     return {
         "decode_tokens_per_s": round(n_tokens / max(wall, 1e-9), 2),
         "ttft_cold_s": round(ttft_cold, 4),
         "ttft_warm_s": round(ttft_warm, 4),
+        "ttft_p99_s": round(ttft_p99, 4) if ttft_p99 else None,
         "slot_occupancy": round(sched.slot_occupancy, 4),
         "serving_compile_count": engine.compile_count,
         # which decode attention the measurement actually exercised —
         # a decode_tokens_per_s number is only comparable to priors on
         # the same path (ISSUE 11)
         "serving_attention_path": engine.attention_path,
+        "serve_metrics": {
+            "queue_depth_p50": qd[len(qd) // 2] if qd else None,
+            "queue_depth_max": qd[-1] if qd else None,
+            "preemptions": counters.get("preemptions", 0),
+            "growth_stalls": counters.get("growth_stalls", 0),
+            "admissions": counters.get("admissions", 0),
+            "completions": counters.get("completions", 0),
+            "ttft_p99_s": round(ttft_p99, 4) if ttft_p99 else None,
+            "ticks": reg.ticks,
+        },
     }
 
 

@@ -93,10 +93,17 @@ JAX_PLATFORMS=cpu python -m ray_lightning_tpu monitor --smoke > /dev/null
 # (ragged prompts, mixed greedy/temperature/top-k) through the
 # continuous-batching engine must decode bitwise-identical to 8
 # independent single-stream generate() runs; request churn must compile
-# the step exactly ONCE; with 2 process replicas an injected SIGKILL
-# mid-stream must classify -> respawn -> reload weights -> replay the
-# lost streams bitwise with the survivor untouched; and the decode step
-# must audit clean under tracecheck (no RLT301/RLT303).
+# the step exactly ONCE (metrics armed — instrumentation must not
+# retrace); with 2 process replicas an injected SIGKILL mid-stream must
+# classify -> respawn -> reload weights -> replay the lost streams
+# bitwise with the survivor untouched; the METRICS legs
+# (docs/OBSERVABILITY.md "serving metrics") must hold: per-replica
+# metrics JSONL on the tick cadence with histogram counts equal to the
+# completed-request count, EXACT cross-replica histogram merge (counts
+# sum, quantiles merge-order independent), a parseable flight.json
+# postmortem with final ticks from the SIGKILL drill, and a live
+# load_signal(); and the decode step must audit clean under tracecheck
+# (no RLT301/RLT303).
 JAX_PLATFORMS=cpu python -m ray_lightning_tpu serve --smoke > /dev/null
 
 # elastic gate (docs/ELASTIC.md): an 8-device fsdp=8 CPU-SPMD

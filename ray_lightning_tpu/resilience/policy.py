@@ -79,6 +79,23 @@ _RETRYABLE_MARKERS = (
     "coordinator",            # jax.distributed rendezvous failures
     "Connection reset",
     "Connection refused",
+    "Connection closed by peer",  # a collective PEER died mid-op — the
+    #                             surviving rank's view of another
+    #                             rank's death (gloo surfaces it as
+    #                             FAILED_PRECONDITION, not UNAVAILABLE);
+    #                             which rank's failure reaches the
+    #                             driver first is a race, and both views
+    #                             must classify the same way (observed:
+    #                             the kill-drill gate flaking FATAL when
+    #                             the survivor's error won)
+    "gloo/transport",         # gloo TRANSPORT-layer failures (tcp pair
+    #                           resets, timeouts — the source path
+    #                           appears in the message) = peer/link
+    #                           loss; deliberately NOT a blanket "gloo"
+    #                           marker, which would relabel a
+    #                           deterministic bug raising through a
+    #                           collective as infrastructure
+    "Timed out waiting for clients",  # gloo rendezvous: peers never came
     "BrokenPipeError",
     "backend unavailable",
     "heartbeat",

@@ -20,6 +20,15 @@ runs; it fails (exit 1) unless ALL of:
     classified, the replica respawns (weights reloaded, step re-warmed
     through the persistent compile cache), the lost streams replay
     bitwise, and the surviving replica's streams are untouched;
+  * the METRICS legs (docs/OBSERVABILITY.md "serving metrics"): the
+    8-stream run emits per-replica metrics JSONL on the tick cadence
+    whose completion-histogram counts equal the completed-request
+    count; histogram merge across the 2 process replicas is EXACT
+    (counts sum, quantiles from the merged buckets are merge-order
+    independent); the injected SIGKILL leaves a parseable
+    ``flight.json`` whose dump carries the final ticks + the
+    resilience classification; `load_signal()` reports; and the engine
+    still compiles exactly once with metrics armed;
   * the decode step audits clean under tracecheck (no RLT301/RLT303);
   * the FUSED paged-attention path (`force_pallas` + interpret on a
     kernel-tiling tiny config): 8 concurrent streams match the
@@ -144,34 +153,46 @@ def run_smoke(args) -> int:
     cfg, model, params, prompts, reqs = _tiny_setup(8, 8)
     refs = _references(model, params, prompts, reqs)
 
-    # ---- leg 1: inline churn — 8 staggered streams through 4 slots ----
-    drv = ServeDriver(cfg, params, ReplicaGroupConfig(
-        n_replicas=1, backend="inline", engine=ecfg,
-        reserve="on_demand"))
-    res = drv.run(list(reqs))
-    bad = _check_outputs(res.outputs, refs)
-    compile_ok = res.stats.get("compile_count") in (1, -1)
-    verdict["legs"]["inline_churn"] = {
-        "bitwise_mismatches": bad,
-        "compile_count": res.stats.get("compile_count"),
-        "slot_occupancy": round(res.stats.get("slot_occupancy") or 0, 3),
-    }
-    if bad:
-        failures.append(f"inline streams diverge from generate(): {bad}")
-    if not compile_ok:
-        failures.append(
-            f"request churn recompiled the step: compile_count="
-            f"{res.stats.get('compile_count')} (want 1)")
+    # ---- leg 1: inline churn — 8 staggered streams through 4 slots,
+    # metrics ARMED (the compile pin below therefore also proves
+    # instrumentation does not retrace the step) ----------------------
+    with tempfile.TemporaryDirectory(prefix="rlt-serve-smoke1-") as tmp1:
+        run1 = os.path.join(tmp1, "run")
+        drv = ServeDriver(cfg, params, ReplicaGroupConfig(
+            n_replicas=1, backend="inline", engine=ecfg,
+            reserve="on_demand", run_dir=run1,
+            metrics_flush_every_n_ticks=4))
+        res = drv.run(list(reqs))
+        bad = _check_outputs(res.outputs, refs)
+        compile_ok = res.stats.get("compile_count") in (1, -1)
+        verdict["legs"]["inline_churn"] = {
+            "bitwise_mismatches": bad,
+            "compile_count": res.stats.get("compile_count"),
+            "slot_occupancy": round(res.stats.get("slot_occupancy")
+                                    or 0, 3),
+        }
+        if bad:
+            failures.append(
+                f"inline streams diverge from generate(): {bad}")
+        if not compile_ok:
+            failures.append(
+                f"request churn recompiled the step (metrics armed): "
+                f"compile_count={res.stats.get('compile_count')} "
+                f"(want 1)")
+        verdict["legs"]["metrics_emission"] = _smoke_metrics_emission(
+            failures, run1, expected_completions=len(reqs))
 
     # ---- leg 2: process replicas + injected SIGKILL -------------------
     with tempfile.TemporaryDirectory(prefix="rlt-serve-smoke-") as tmp:
         pp = os.path.join(tmp, "params.npz")
         save_params_npz(params, pp)
+        run2 = os.path.join(tmp, "run")
         drv2 = ServeDriver(cfg, pp, ReplicaGroupConfig(
             n_replicas=2, backend="process", engine=ecfg,
-            run_dir=os.path.join(tmp, "run"),
+            run_dir=run2,
             compile_cache_dir=os.path.join(tmp, "compile_cache"),
-            env={"JAX_PLATFORMS": "cpu"}))
+            env={"JAX_PLATFORMS": "cpu"},
+            metrics_flush_every_n_ticks=4, flight_persist_every=4))
         # the driver copies requests before stamping, so the same list
         # serves both legs without leaking leg 1's arrival times
         res2 = drv2.run(list(reqs), fault={"replica": 1,
@@ -193,6 +214,10 @@ def run_smoke(args) -> int:
         # without interruption (no restart there)
         if res2.restarts.get(0, 0) != 0:
             failures.append("the SURVIVING replica restarted too")
+        verdict["legs"]["metrics_merge"] = _smoke_metrics_merge(
+            failures, run2)
+        verdict["legs"]["flight_recorder"] = _smoke_flight(
+            failures, run2)
 
     # ---- leg 3: decode step audits clean ------------------------------
     report = audit_decode_step(cfg, ecfg, topology=args.topo)
@@ -215,6 +240,137 @@ def run_smoke(args) -> int:
             print(f"serve --smoke FAILED: {f}", file=sys.stderr)
         return 1
     return 0
+
+
+def _smoke_metrics_emission(failures: list, run_dir: str,
+                            expected_completions: int) -> dict:
+    """Metrics leg A (docs/OBSERVABILITY.md "serving metrics"): the
+    8-stream run must leave per-replica metrics JSONL on the tick
+    cadence whose completion-histogram counts equal the
+    completed-request count, and `load_signal()` must report."""
+    from ray_lightning_tpu.serve.driver import load_signal
+    from ray_lightning_tpu.telemetry.metrics import (
+        metrics_paths, read_metrics,
+    )
+
+    tdir = os.path.join(run_dir, "telemetry")
+    paths = metrics_paths(tdir)
+    leg: dict = {"files": [os.path.basename(p) for p in paths]}
+    if not paths:
+        failures.append("serving left no per-replica metrics JSONL")
+        return leg
+    ticks = 0
+    completions = 0
+    hist_ns = {}
+    for p in paths:
+        parsed = read_metrics(p)
+        ticks += len(parsed["ticks"])
+        completions += int(parsed["counters"].get("completions", 0))
+        for name, h in parsed["hists"].items():
+            hist_ns[name] = hist_ns.get(name, 0) + h.n
+    leg.update({"ticks": ticks, "completions": completions,
+                "hist_counts": hist_ns})
+    if ticks < 1:
+        failures.append("metrics JSONL holds no tick samples — the "
+                        "tick-cadence flush never fired")
+    for name in ("ttft_s", "tpot_s", "queue_wait_s"):
+        if hist_ns.get(name) != expected_completions:
+            failures.append(
+                f"histogram {name} counts {hist_ns.get(name)} != "
+                f"completed-request count {expected_completions}")
+    if completions != expected_completions:
+        failures.append(
+            f"completions counter {completions} != "
+            f"{expected_completions}")
+    sig = load_signal(run_dir)
+    leg["load_signal"] = {k: sig.get(k) for k in
+                          ("available", "queue_depth_p50",
+                           "occupancy", "pressure")}
+    if not sig.get("available"):
+        failures.append("load_signal() reports unavailable on a run "
+                        "that just served")
+    return leg
+
+
+def _smoke_metrics_merge(failures: list, run_dir: str) -> dict:
+    """Metrics leg B: histogram merge across the 2 process replicas
+    must be EXACT — counts sum as integers, and the p50/p95/p99 read
+    from merged buckets is identical whichever merge order produced
+    them."""
+    from ray_lightning_tpu.telemetry.metrics import (
+        merge_histograms, metrics_paths, read_metrics,
+    )
+
+    tdir = os.path.join(run_dir, "telemetry")
+    paths = metrics_paths(tdir)
+    leg: dict = {"files": [os.path.basename(p) for p in paths]}
+    parts = []
+    for p in paths:
+        h = read_metrics(p)["hists"].get("ttft_s")
+        if h is not None:
+            parts.append(h)
+    leg["parts"] = len(parts)
+    if len(parts) < 2:
+        failures.append(
+            "metrics merge leg needs ttft_s histograms from >= 2 "
+            f"replica files, found {len(parts)}")
+        return leg
+    fwd = merge_histograms(parts)
+    rev = merge_histograms(list(reversed(parts)))
+    leg["merged_n"] = fwd.n
+    leg["sum_of_parts"] = sum(h.n for h in parts)
+    leg["p99_fwd"] = fwd.quantile(0.99)
+    leg["p99_rev"] = rev.quantile(0.99)
+    if fwd.n != sum(h.n for h in parts):
+        failures.append(
+            f"merged histogram count {fwd.n} != sum of per-replica "
+            f"counts {sum(h.n for h in parts)} — merge is not exact")
+    if fwd.counts != rev.counts or any(
+            fwd.quantile(q) != rev.quantile(q)
+            for q in (0.5, 0.95, 0.99)):
+        failures.append("histogram merge is order-dependent — "
+                        "quantiles from merged buckets must not care "
+                        "which replica's file merged first")
+    return leg
+
+
+def _smoke_flight(failures: list, run_dir: str) -> dict:
+    """Metrics leg C: the injected SIGKILL must leave a parseable
+    ``flight.json`` whose dump carries the dead replica's final ticks
+    and the resilience classification the driver stamped on."""
+    path = os.path.join(run_dir, "flight.json")
+    leg: dict = {"path": path}
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as exc:
+        failures.append(f"no parseable flight.json after the SIGKILL "
+                        f"drill: {type(exc).__name__}: {exc}")
+        return leg
+    dumps = doc.get("dumps") or []
+    leg["dumps"] = len(dumps)
+    if not dumps:
+        failures.append("flight.json holds no dumps")
+        return leg
+    dump = dumps[0]
+    events = dump.get("events") or []
+    tick_events = [e for e in events if e.get("kind") == "tick"]
+    leg.update({
+        "replica": dump.get("replica"),
+        "events": len(events),
+        "tick_events": len(tick_events),
+        "last_tick": tick_events[-1].get("tick") if tick_events
+        else None,
+        "death": dump.get("death"),
+    })
+    if not tick_events:
+        failures.append("flight dump carries no tick events — the "
+                        "postmortem has no final ticks to read")
+    death = dump.get("death") or {}
+    if not death.get("kind"):
+        failures.append("flight dump is missing the resilience "
+                        "classification (death.kind)")
+    return leg
 
 
 def _smoke_fused_leg(failures: list, topo: str) -> dict:

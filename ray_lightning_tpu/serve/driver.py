@@ -24,7 +24,23 @@ Telemetry: each replica owns a `telemetry.TelemetryRecorder` and
 records the serving span vocabulary (queue_wait / prefill / decode /
 detokenize, spans.SERVE_PHASES) per COMPLETED request — cadence-safe —
 plus per-request TTFT/TPOT meta the `report` CLI aggregates into its
-serving section (docs/OBSERVABILITY.md).
+serving section (docs/OBSERVABILITY.md). PREEMPTED requests get
+REPLAYED-tagged spans for their discarded prefix, and whatever is
+still in flight at drain time gets INFLIGHT-tagged spans, so a
+preempt-heavy or killed run stops under-reporting queue_wait (the tags
+keep the report from double-counting the replayed prefix).
+
+Live metrics (telemetry/metrics.py): each replica additionally owns a
+`MetricsRegistry` (per-tick queue/slot/pool gauges, event counters,
+mergeable latency histograms, flushed to uid-tagged JSONL on the tick
+cadence) and a `FlightRecorder` (bounded ring of recent ticks +
+scheduler events, cadence-persisted). The driver merges the per-replica
+streams into run-level histograms in ``serving.json`` (quantiles from
+BUCKETS, exact across replicas and respawned attempts), finalizes a
+dead replica's flight ring into ``<run_dir>/flight.json`` stamped with
+the resilience classification, and exposes `load_signal(run_dir)` —
+the queue-depth/occupancy oracle input ROADMAP item 1(c) autoscale
+consumes.
 """
 from __future__ import annotations
 
@@ -94,6 +110,18 @@ class ReplicaGroupConfig:
     #: extra env for process replicas (e.g. {"JAX_PLATFORMS": "cpu"})
     env: Optional[Dict[str, str]] = None
     start_timeout: float = 180.0
+    #: live metrics + flight recorder (telemetry/metrics.py) — armed
+    #: only when ``run_dir`` is set; False turns both off even then
+    #: (the zero-overhead pin covers the off state)
+    metrics: bool = True
+    #: metrics JSONL flush cadence in engine ticks (RLT501: never 1-ish
+    #: small on a hot production loop; the smoke uses small values so
+    #: short runs still land samples)
+    metrics_flush_every_n_ticks: int = 32
+    #: flight-recorder ring length (recent ticks + scheduler events)
+    flight_ring: int = 256
+    #: flight ring persist cadence in recorded events
+    flight_persist_every: int = 16
 
     def __post_init__(self):
         if self.backend not in ("inline", "process"):
@@ -137,6 +165,55 @@ def _record_completion(recorder, comp: Completion, replica: int) -> None:
     recorder.record(PH_DECODE, decode_start, comp.decode_s, meta=meta)
 
 
+def _record_partial_spans(recorder, info: dict, meta: dict) -> None:
+    """Back-dated queue_wait / prefill / decode spans for a request's
+    PARTIAL progress (`Scheduler._partial_timing` shape). The one place
+    span back-dating happens for non-completed requests — preemption
+    and drain accounting can never drift apart. ``meta`` must carry the
+    distinguishing tag (``replayed`` / ``inflight``) and must NOT carry
+    ``ttft_s``: its absence is what keeps the report's per-request
+    aggregation from double-counting these."""
+    from ray_lightning_tpu.telemetry.spans import (
+        PH_DECODE, PH_PREFILL, PH_QUEUE_WAIT,
+    )
+
+    now = time.perf_counter()
+    decode_start = now - info["decode_s"]
+    prefill_start = decode_start - info["prefill_s"]
+    recorder.record(PH_QUEUE_WAIT,
+                    prefill_start - info["queue_wait_s"],
+                    info["queue_wait_s"], meta=meta)
+    if info["prefill_s"] > 0:
+        recorder.record(PH_PREFILL, prefill_start, info["prefill_s"],
+                        meta=meta)
+    if info["decode_s"] > 0:
+        recorder.record(PH_DECODE, decode_start, info["decode_s"],
+                        meta=meta)
+
+
+def _record_preemption(recorder, detail: dict, replica: int) -> None:
+    """Spans for the DISCARDED prefix of a just-preempted request,
+    tagged ``replayed`` — the report shows the wall this prefix burned
+    without double-counting it into the request's final latency (the
+    retirement spans cover the replayed run)."""
+    _record_partial_spans(recorder, detail, {
+        "rid": detail["rid"], "replica": replica, "replayed": True,
+        "emitted": detail["emitted"], "preempted": detail["preempted"]})
+
+
+def _record_drain(recorder, sched, replica: int) -> None:
+    """Spans for requests STILL IN FLIGHT when serving stops (replica
+    death, shutdown): tagged ``inflight`` so their partial queue_wait /
+    prefill / decode wall is accounted instead of vanishing with the
+    slot state. Tags keep the report from treating them as completed
+    requests."""
+    for info in sched.inflight_snapshot():
+        _record_partial_spans(recorder, info, {
+            "rid": info["rid"], "replica": replica, "inflight": True,
+            "state": info["state"], "emitted": info["emitted"],
+            "preempted": info["preempted"]})
+
+
 def _make_recorder(run_dir: Optional[str], replica: int):
     from ray_lightning_tpu.telemetry.spans import (
         NULL_RECORDER, TelemetryRecorder,
@@ -148,6 +225,33 @@ def _make_recorder(run_dir: Optional[str], replica: int):
         os.path.join(run_dir, "telemetry"), rank=replica)
 
 
+def _make_metrics(run_dir: Optional[str], replica: int,
+                  enabled: bool = True, flush_every: int = 32):
+    from ray_lightning_tpu.telemetry.metrics import (
+        NULL_METRICS, MetricsRegistry,
+    )
+
+    if run_dir is None or not enabled:
+        return NULL_METRICS
+    return MetricsRegistry(os.path.join(run_dir, "telemetry"),
+                           replica=replica,
+                           flush_every_n_ticks=flush_every)
+
+
+def _make_flight(run_dir: Optional[str], replica: int,
+                 enabled: bool = True, maxlen: int = 256,
+                 persist_every: int = 16):
+    from ray_lightning_tpu.telemetry.metrics import (
+        NULL_FLIGHT, FlightRecorder, flight_path,
+    )
+
+    if run_dir is None or not enabled:
+        return NULL_FLIGHT
+    return FlightRecorder(
+        flight_path(os.path.join(run_dir, "telemetry"), replica),
+        replica=replica, maxlen=maxlen, persist_every=persist_every)
+
+
 # ---- one replica's serving loop (runs in-process or in the worker) --------
 
 def _serve_loop(engine: DecodeEngine, reserve: str,
@@ -155,14 +259,28 @@ def _serve_loop(engine: DecodeEngine, reserve: str,
                 run_dir: Optional[str] = None,
                 on_token=None, on_completion=None, on_preempt=None,
                 fault: Optional[dict] = None,
-                fault_dir: Optional[str] = None):
+                fault_dir: Optional[str] = None,
+                metrics_cfg: Optional[dict] = None):
     """Drain ``requests`` through one replica. ``on_token(rid, tok)``
     streams tokens as they are emitted; ``on_completion(comp)`` fires at
     retirement. ``fault={"kill_after_tokens": n}`` SIGKILLs this process
     after the n-th emitted token, once per ``fault_dir`` marker — the
-    smoke gate's mid-stream replica death."""
+    smoke gate's mid-stream replica death. ``metrics_cfg`` carries the
+    `ReplicaGroupConfig` metrics knobs (enabled / flush cadence / flight
+    ring)."""
+    mc = metrics_cfg or {}
     recorder = _make_recorder(run_dir, replica)
-    sched = Scheduler(engine, reserve=reserve)
+    metrics = _make_metrics(run_dir, replica,
+                            enabled=mc.get("enabled", True),
+                            flush_every=mc.get("flush_every", 32))
+    flight = _make_flight(run_dir, replica,
+                          enabled=mc.get("enabled", True),
+                          maxlen=mc.get("flight_ring", 256),
+                          persist_every=mc.get("flight_persist_every",
+                                               16))
+    engine.metrics = metrics
+    sched = Scheduler(engine, reserve=reserve, metrics=metrics,
+                      flight=flight)
     for req in requests:
         sched.submit(req)
     emitted_total = 0
@@ -172,11 +290,13 @@ def _serve_loop(engine: DecodeEngine, reserve: str,
     done: List[Completion] = []
     while sched.busy():
         completions = sched.tick()
-        for rid in sched.last_preemptions:
-            # the replay regenerates the stream bitwise — a consumer
-            # keeping the pre-preemption prefix would duplicate tokens
+        for detail in sched.last_preemption_details:
+            # account the discarded prefix (replayed-tagged) — the
+            # replay regenerates the stream bitwise, so a consumer
+            # keeping the prefix would duplicate tokens
+            _record_preemption(recorder, detail, replica)
             if on_preempt is not None:
-                on_preempt(rid)
+                on_preempt(detail["rid"])
         for rid, tok in sched.last_emissions:
             emitted_total += 1
             if on_token is not None:
@@ -191,13 +311,23 @@ def _serve_loop(engine: DecodeEngine, reserve: str,
         if (kill_after and emitted_total >= kill_after and marker
                 and not os.path.exists(marker)):
             # fire-once across respawns: the marker outlives this
-            # process, so the replayed replica serves to completion
+            # process, so the replayed replica serves to completion.
+            # Drain-time accounting + a final metrics/flight flush land
+            # BEFORE the kill — the injected drill leaves an exact
+            # final-ticks postmortem (a real SIGKILL leaves the last
+            # cadence-persisted ring, at most one cadence stale).
             with open(marker, "w") as f:
                 f.write(str(emitted_total))
+            _record_drain(recorder, sched, replica)
             recorder.flush()
+            metrics.flush()
+            flight.persist()
             os.kill(os.getpid(), signal.SIGKILL)
+    _record_drain(recorder, sched, replica)
     recorder.flush()
     recorder.close()
+    metrics.close()
+    flight.close()
     return done, sched
 
 
@@ -209,7 +339,8 @@ def _replica_worker_main(model_cfg_kw: dict, params_path: str,
                          run_dir: Optional[str],
                          compile_cache_dir: Optional[str],
                          fault: Optional[dict],
-                         fault_dir: Optional[str]) -> dict:
+                         fault_dir: Optional[str],
+                         metrics_cfg: Optional[dict] = None) -> dict:
     """Runs inside the WorkerGroup worker process: rebuild the model,
     reload weights, warm the step (persistent compile cache when
     armed), announce live, then serve — streaming every token over the
@@ -256,7 +387,8 @@ def _replica_worker_main(model_cfg_kw: dict, params_path: str,
                               run_dir=run_dir, on_token=on_token,
                               on_completion=on_completion,
                               on_preempt=on_preempt, fault=fault,
-                              fault_dir=fault_dir)
+                              fault_dir=fault_dir,
+                              metrics_cfg=metrics_cfg)
     return {"replica": replica, "completed": len(done),
             "steps": engine.steps, "warmup_s": warm_s,
             "compile_count": engine.compile_count,
@@ -286,6 +418,12 @@ class ServeDriver:
                 "process replicas need a params .npz path "
                 "(save_params_npz) — the respawn path reloads from it")
 
+    def _metrics_cfg(self) -> dict:
+        return {"enabled": self.cfg.metrics,
+                "flush_every": self.cfg.metrics_flush_every_n_ticks,
+                "flight_ring": self.cfg.flight_ring,
+                "flight_persist_every": self.cfg.flight_persist_every}
+
     # ---- inline ----------------------------------------------------------
 
     def _run_inline(self, requests: Sequence[Request],
@@ -302,13 +440,23 @@ class ServeDriver:
         t0 = time.perf_counter()
         n_tokens = 0
         scheds = []
+        recorders = []
+        mc = self._metrics_cfg()
         for r in range(self.cfg.n_replicas):
-            engine = DecodeEngine(model, params, self.cfg.engine)
+            metrics = _make_metrics(self.cfg.run_dir, r,
+                                    enabled=mc["enabled"],
+                                    flush_every=mc["flush_every"])
+            flight = _make_flight(
+                self.cfg.run_dir, r, enabled=mc["enabled"],
+                maxlen=mc["flight_ring"],
+                persist_every=mc["flight_persist_every"])
+            engine = DecodeEngine(model, params, self.cfg.engine,
+                                  metrics=metrics)
             engine.warmup()
-            sched = Scheduler(engine, reserve=self.cfg.reserve)
+            sched = Scheduler(engine, reserve=self.cfg.reserve,
+                              metrics=metrics, flight=flight)
             scheds.append(sched)
-        recorders = [_make_recorder(self.cfg.run_dir, r)
-                     for r in range(self.cfg.n_replicas)]
+            recorders.append(_make_recorder(self.cfg.run_dir, r))
         for i, req in enumerate(requests):
             scheds[i % len(scheds)].submit(req)
             outputs[req.rid] = []
@@ -319,8 +467,11 @@ class ServeDriver:
                 if not sched.busy():
                     continue
                 completions = sched.tick()
-                for rid in sched.last_preemptions:
-                    outputs[rid] = []  # the replay resends from scratch
+                for detail in sched.last_preemption_details:
+                    # the replay resends from scratch; the discarded
+                    # prefix is accounted as a replayed-tagged span
+                    outputs[detail["rid"]] = []
+                    _record_preemption(recorders[r], detail, r)
                 for rid, tok in sched.last_emissions:
                     outputs[rid].append(tok)
                     n_tokens += 1
@@ -337,8 +488,11 @@ class ServeDriver:
         wall = time.perf_counter() - t0
         for r, sched in enumerate(scheds):
             stats_occ.append(sched.slot_occupancy)
+            _record_drain(recorders[r], sched, r)
             recorders[r].flush()
             recorders[r].close()
+            sched.metrics.close()
+            sched.flight.close()
         stats = {
             "decode_tokens_per_s": n_tokens / max(wall, 1e-9),
             "slot_occupancy": float(np.mean(stats_occ)),
@@ -430,7 +584,7 @@ class ServeDriver:
                             [_req_dict(q) for q in remaining], r,
                             self.cfg.run_dir,
                             self.cfg.compile_cache_dir, rep_fault,
-                            fault_dir),
+                            fault_dir, self._metrics_cfg()),
                         on_queue_item=on_queue_item)
                     with lock:
                         occupancy[r] = res[0]["occupancy"]
@@ -441,8 +595,28 @@ class ServeDriver:
                     log.warning(
                         "serve replica %d died (%s/%s): %s", r, fc.kind,
                         fc.cause, fc.detail)
-                    if (not fc.restartable
-                            or restarts[r] >= self.cfg.max_restarts):
+                    respawning = (fc.restartable
+                                  and restarts[r] < self.cfg.max_restarts)
+                    # flight-recorder postmortem: the dead worker's last
+                    # cadence-persisted ring, stamped with the
+                    # resilience classification — the SIGKILL drill's
+                    # readable last-N-ticks record next to the log tail
+                    if self.cfg.run_dir and self.cfg.metrics:
+                        from ray_lightning_tpu.telemetry.metrics import (
+                            finalize_flight,
+                        )
+
+                        finalize_flight(
+                            os.path.join(self.cfg.run_dir, "telemetry"),
+                            r,
+                            {"kind": fc.kind, "cause": fc.cause,
+                             "detail": fc.detail,
+                             "restartable": fc.restartable,
+                             "restarts_so_far": restarts[r],
+                             "respawning": respawning},
+                            os.path.join(self.cfg.run_dir,
+                                         "flight.json"))
+                    if not respawning:
                         with lock:
                             errors.append(exc)
                         return
@@ -506,10 +680,81 @@ class ServeDriver:
         if self.cfg.run_dir is None:
             return
         os.makedirs(self.cfg.run_dir, exist_ok=True)
+        from ray_lightning_tpu.telemetry.metrics import (
+            aggregate_from_parsed, load_signal_from_parsed,
+            newest_from_parsed, read_all_metrics,
+        )
+
+        doc = {"stats": result.stats, "meta": result.meta,
+               "restarts": result.restarts}
+        tdir = _serve_metrics_dir(self.cfg.run_dir)
+        parsed = read_all_metrics(tdir)  # one pass feeds both rollups
+        agg = aggregate_from_parsed(parsed)
+        if agg is not None:
+            # run-level rollup of the per-replica metric streams:
+            # latency quantiles FROM MERGED BUCKETS (exact across
+            # replicas/attempts), counters summed, and the rolling
+            # load summary the autoscale oracle reads
+            doc["metrics"] = agg
+            doc["load"] = load_signal_from_parsed(
+                newest_from_parsed(parsed), where=tdir)
         path = os.path.join(self.cfg.run_dir, "serving.json")
         with open(path, "w") as f:
-            json.dump({"stats": result.stats, "meta": result.meta,
-                       "restarts": result.restarts}, f, indent=2)
+            json.dump(doc, f, indent=2)
+
+
+# ---- run-level metric aggregation + the autoscale load signal -------------
+
+
+def _serve_metrics_dir(run_dir: str) -> str:
+    tdir = os.path.join(run_dir, "telemetry")
+    return tdir if os.path.isdir(tdir) else run_dir
+
+
+def aggregate_serve_metrics(run_dir: str) -> Optional[dict]:
+    """Merge every per-replica metrics JSONL under
+    ``<run_dir>/telemetry`` into one run-level view: summed counters,
+    exactly-merged latency histograms (quantiles from buckets),
+    per-replica tick/attempt counts, and queue-depth/occupancy series
+    stats. None when the run recorded no metrics (metrics off, or
+    nothing served)."""
+    from ray_lightning_tpu.telemetry.metrics import aggregate_metrics_dir
+
+    return aggregate_metrics_dir(_serve_metrics_dir(run_dir))
+
+
+def load_signal(run_dir: str, window: Optional[int] = None) -> dict:
+    """The queue-depth/occupancy oracle input for replica autoscale
+    (ROADMAP item 1c) and the elastic capacity oracle
+    (docs/OBSERVABILITY.md "load signal").
+
+    Reads the NEWEST metrics file per replica under
+    ``<run_dir>/telemetry`` and summarizes the last ``window`` tick
+    samples each flushed:
+
+      available            False when no metrics exist yet (a caller
+                           must treat that as "no signal", never zero
+                           load)
+      queue_depth_now      summed latest queue depth across replicas
+      queue_depth_p50/max  over the recent window, all replicas pooled
+      occupancy            mean decoding-slot fraction over the window
+      blocks_free_fraction pool headroom (min across replicas)
+      pressure             queue_depth_p50 / total_slots — > 0 means
+                           demand is queuing behind capacity; the
+                           dimensionless number an autoscaler compares
+                           against its scale-up threshold
+      replicas             per-replica {queue_depth, occupancy, ticks}
+
+    The signal is computed from FLUSHED samples, so it lags live state
+    by at most one flush cadence — the honest price of RLT501's
+    no-per-tick-I/O discipline."""
+    from ray_lightning_tpu.telemetry.metrics import (
+        LOAD_SIGNAL_WINDOW, load_signal_from_dir,
+    )
+
+    return load_signal_from_dir(
+        _serve_metrics_dir(run_dir),
+        window=window if window is not None else LOAD_SIGNAL_WINDOW)
 
 
 def _req_dict(req: Request) -> dict:

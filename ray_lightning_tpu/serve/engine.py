@@ -414,7 +414,8 @@ class DecodeEngine:
 
     def __init__(self, model, params, cfg: EngineConfig,
                  max_seq_len_check: bool = True,
-                 use_pallas: Optional[bool] = None):
+                 use_pallas: Optional[bool] = None,
+                 metrics=None):
         if max_seq_len_check and cfg.max_slot_len > model.cfg.max_seq_len:
             raise ValueError(
                 f"engine max_slot_len {cfg.max_slot_len} exceeds the "
@@ -470,6 +471,16 @@ class DecodeEngine:
             jnp.zeros((cfg.capacity, model.cfg.vocab_size), jnp.float32),
             device)
         self.steps = 0
+        # live metrics (telemetry/metrics.py): per-tick prefill/decode
+        # token counts + the compile counter. The registry NEVER enters
+        # build_step — metrics on or off lowers a byte-identical
+        # program (test-pinned), and every recorded value is computed
+        # from the host-owned numpy inputs the tick already received
+        # (no new host syncs). Assignable after construction: the serve
+        # loop arms it once the run dir is known.
+        from ray_lightning_tpu.telemetry.metrics import NULL_METRICS
+
+        self.metrics = metrics if metrics is not None else NULL_METRICS
 
     # ---- compile accounting ---------------------------------------------
 
@@ -533,4 +544,23 @@ class DecodeEngine:
         (self.pool_k, self.pool_v, self.last_logits, new_rngs,
          emitted) = self._step(*args)
         self.steps += 1
+        m = self.metrics
+        if m.enabled:
+            # counted from the HOST-OWNED inputs this call received —
+            # the device outputs above stay un-inspected, so metrics
+            # adds zero host syncs. prefill_tokens counts chunk
+            # positions advanced (incl. pad columns on the batched
+            # lane); decode_tokens counts slots that sampled.
+            n_dec = int(np.sum(np.asarray(decoding)))
+            if self.cfg.prefill_batch == 1:
+                n_pf_rows = 1 if int(prefill[0]) >= 0 else 0
+            else:
+                n_pf_rows = int(np.sum(np.asarray(prefill[0]) >= 0))
+            if n_dec:
+                m.count("decode_tokens", n_dec)
+            if n_pf_rows:
+                m.count("prefill_tokens",
+                        n_pf_rows * self.cfg.prefill_chunk)
+            m.gauge("engine_steps", self.steps)
+            m.gauge("compile_count", self.compile_count)
         return np.array(emitted), np.array(new_rngs)

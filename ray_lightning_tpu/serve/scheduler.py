@@ -22,6 +22,7 @@ import numpy as np
 
 from ray_lightning_tpu.serve.engine import DecodeEngine, idle_prefill
 from ray_lightning_tpu.serve.kv_cache import BlockAllocator, new_block_table
+from ray_lightning_tpu.telemetry.metrics import NULL_FLIGHT, NULL_METRICS
 
 
 @dataclasses.dataclass
@@ -120,9 +121,20 @@ class Scheduler:
     delayed, never corrupted.
     """
 
-    def __init__(self, engine: DecodeEngine, reserve: str = "worst_case"):
+    def __init__(self, engine: DecodeEngine, reserve: str = "worst_case",
+                 metrics=None, flight=None):
         if reserve not in ("worst_case", "on_demand"):
             raise ValueError(f"reserve={reserve!r}")
+        #: live metrics (telemetry/metrics.py): per-tick gauges + event
+        #: counters + completion latency histograms — every recorded
+        #: value is a plain host scalar the tick computed anyway, so
+        #: metrics on/off never changes the engine program or adds a
+        #: host sync (test-pinned)
+        self.metrics = metrics if metrics is not None else NULL_METRICS
+        #: flight recorder: bounded ring of recent ticks + scheduler
+        #: events, cadence-persisted — the postmortem a dead replica
+        #: leaves behind (docs/OBSERVABILITY.md "flight recorder")
+        self.flight = flight if flight is not None else NULL_FLIGHT
         self.engine = engine
         self.cfg = engine.cfg
         self.spec = engine.spec
@@ -152,6 +164,11 @@ class Scheduler:
         #: regenerates it bitwise; keeping the prefix would duplicate
         #: tokens — review finding, regression-pinned)
         self.last_preemptions: List[str] = []
+        #: partial-progress timing for the MOST RECENT tick's
+        #: preemptions — the driver records these as REPLAYED-tagged
+        #: spans so a preempt-heavy run stops under-reporting
+        #: queue_wait without double-counting the replayed prefix
+        self.last_preemption_details: List[dict] = []
         self._seq = 0
         self._queue_wait: Dict[str, float] = {}
         #: running occupancy: decoding-slot fraction summed over ticks
@@ -235,6 +252,9 @@ class Scheduler:
         self.rngs[s] = _key_data(req.seed)
         self._queue_wait[req.rid] = (
             slot.admitted_at - req.arrival if req.arrival else 0.0)
+        self.metrics.count("admissions")
+        self.flight.record("admit", rid=req.rid, slot=s,
+                           blocks=len(blocks), preempted=preempts)
         return s
 
     def _admit(self) -> None:
@@ -242,6 +262,8 @@ class Scheduler:
             while self.queue and self.free_slots:
                 s = self._admit_one(self.queue[0][0].prompt.size)
                 if s is None:
+                    # pool short: the queue head defers to a later tick
+                    self.metrics.count("admission_deferrals")
                     return
                 self.prefill_groups.append(
                     _PrefillGroup([s], self.slots[s].req.prompt.size))
@@ -268,6 +290,7 @@ class Scheduler:
                     break  # heads the next group instead
                 s = self._admit_one(width)
                 if s is None:
+                    self.metrics.count("admission_deferrals")
                     break  # pool short
                 group.append(s)
             if not group:
@@ -293,6 +316,12 @@ class Scheduler:
         is discarded, the stream restarts delayed but identical)."""
         slot = self.slots.pop(s)
         self.last_preemptions.append(slot.req.rid)
+        self.last_preemption_details.append(self._partial_timing(
+            slot, time.perf_counter(), preempted=slot.preempted + 1))
+        self.metrics.count("preemptions")
+        self.flight.record("preempt", rid=slot.req.rid, slot=s,
+                           emitted=len(slot.emitted),
+                           preempted=slot.preempted + 1)
         self.alloc.free(slot.blocks)
         self.tables[s, :] = 0
         self.decoding[s] = False
@@ -327,6 +356,16 @@ class Scheduler:
         self.pad[s] = 0
         self.free_slots.append(s)
         self.completions.append(comp)
+        m = self.metrics
+        if m.enabled:
+            m.count("completions")
+            m.observe("queue_wait_s", comp.queue_wait_s)
+            m.observe("ttft_s", comp.ttft_s)
+            m.observe("tpot_s", comp.tpot_s)
+            m.observe("decode_s", comp.decode_s)
+        self.flight.record("retire", rid=comp.rid, slot=s, reason=reason,
+                           tokens=len(comp.tokens),
+                           preempted=comp.preempted)
         return comp
 
     # ---- the tick --------------------------------------------------------
@@ -335,6 +374,7 @@ class Scheduler:
         """Admit -> prefill-chunk pick -> engine step -> account.
         Returns the requests that COMPLETED this tick."""
         self.last_preemptions = []
+        self.last_preemption_details = []
         self._admit()
         # growth check before the step: every decoding slot must own
         # the block its write lands in. On a dry pool a grower may only
@@ -352,6 +392,10 @@ class Scheduler:
                 continue  # preempted as a victim earlier this tick
             me = self.slots[s]
             while not self._grow(s, me):
+                # a dry pool at a growth boundary: the signal item 1(c)
+                # autoscale watches — every stall is one eviction (or a
+                # self-preempt) the pool's size forced
+                self.metrics.count("growth_stalls")
                 victims = [v for v in self.slots
                            if self.slots[v].seq > me.seq]
                 if victims:
@@ -463,6 +507,29 @@ class Scheduler:
                 done.append(self._retire(s, "eos"))
             elif len(slot.emitted) >= req.max_new_tokens:
                 done.append(self._retire(s, "length"))
+        m = self.metrics
+        if m.enabled or self.flight.enabled:
+            # every value below is host bookkeeping the tick already
+            # holds in plain python/numpy — no device array is touched
+            queue_depth = len(self.queue)
+            decoding = int(self.decoding.sum())
+            prefilling = sum(len(g.slots) for g in self.prefill_groups)
+            free = self.alloc.free_blocks
+            total = self.spec.n_blocks - 1  # block 0 is scratch
+            if m.enabled:
+                m.gauge("queue_depth", queue_depth)
+                m.gauge("decoding_slots", decoding)
+                m.gauge("prefilling_slots", prefilling)
+                m.gauge("free_slots", len(self.free_slots))
+                m.gauge("blocks_free", free)
+                m.gauge("blocks_in_use", total - free)
+                m.gauge("slot_occupancy", float(was_decoding.mean()))
+            self.flight.record("tick", tick=self._ticks,
+                               queue_depth=queue_depth,
+                               decoding=decoding, prefilling=prefilling,
+                               blocks_free=free,
+                               completed=len(done))
+            m.tick_end()
         return done
 
     # ---- metrics ---------------------------------------------------------
@@ -471,3 +538,46 @@ class Scheduler:
     def slot_occupancy(self) -> float:
         """Mean decoding-slot fraction over all ticks so far."""
         return self._occupancy_sum / max(1, self._ticks)
+
+    def _partial_timing(self, slot: _Slot, now: float,
+                        preempted: int) -> dict:
+        """One request's partial-progress timing — the shared shape
+        behind `last_preemption_details` and `inflight_snapshot` (the
+        driver back-dates spans from exactly these fields, so the two
+        accountings can never drift apart)."""
+        first = slot.first_token_at
+        return {
+            "rid": slot.req.rid,
+            "queue_wait_s": self._queue_wait.get(slot.req.rid, 0.0),
+            "prefill_s": (first if first is not None else now)
+            - slot.admitted_at,
+            "decode_s": (now - first) if first is not None else 0.0,
+            "emitted": len(slot.emitted),
+            "preempted": preempted,
+        }
+
+    def inflight_snapshot(self) -> List[dict]:
+        """Partial-progress timing for every request the scheduler
+        still holds — slotted (prefilling/decoding) and queued. The
+        driver records these as INFLIGHT-tagged serving spans at drain
+        time, so a run that stops mid-flight (replica death, shutdown)
+        accounts the wall its unfinished requests already spent instead
+        of dropping it (docs/OBSERVABILITY.md "serving spans")."""
+        now = time.perf_counter()
+        out: List[dict] = []
+        for s, slot in self.slots.items():
+            out.append({
+                **self._partial_timing(slot, now,
+                                       preempted=slot.preempted),
+                "state": "decoding" if self.decoding[s]
+                else "prefilling",
+            })
+        for req, preempts in self.queue:
+            out.append({
+                "rid": req.rid, "state": "queued",
+                "queue_wait_s": (now - req.arrival) if req.arrival
+                else 0.0,
+                "prefill_s": 0.0, "decode_s": 0.0, "emitted": 0,
+                "preempted": preempts,
+            })
+        return out

@@ -3,6 +3,10 @@ and the static-vs-measured reconciliation report (docs/OBSERVABILITY.md).
 
   spans.py     host-side span recorder (bounded ring -> per-rank JSONL);
                zero new host syncs, byte-identical program when off
+  metrics.py   live serving metrics: counters/gauges, mergeable
+               log-bucket histograms, bounded per-tick time-series,
+               and the replica flight recorder (docs/OBSERVABILITY.md
+               "serving metrics")
   goodput.py   productive/compile/data-wait/ckpt-stall/backoff/replay
                wall-time classification, worker ledgers + driver assembly
   profiler.py  Trainer(profile=ProfileConfig(...)): step-window /
@@ -19,6 +23,17 @@ from ray_lightning_tpu.telemetry.goodput import (  # noqa: F401
     worker_ledger,
     write_goodput,
     write_ledger,
+)
+from ray_lightning_tpu.telemetry.metrics import (  # noqa: F401
+    NULL_FLIGHT,
+    NULL_METRICS,
+    FlightRecorder,
+    Histogram,
+    MetricsRegistry,
+    NullMetrics,
+    merge_histograms,
+    read_flight,
+    read_metrics,
 )
 from ray_lightning_tpu.telemetry.profiler import (  # noqa: F401
     ProfileConfig,
@@ -38,6 +53,9 @@ __all__ = [
     "write_goodput", "write_ledger", "ProfileConfig",
     "ProfilerController", "NULL_RECORDER", "PHASES", "NullRecorder",
     "TelemetryRecorder", "TelemetryConfig", "read_spans",
+    "NULL_FLIGHT", "NULL_METRICS", "FlightRecorder", "Histogram",
+    "MetricsRegistry", "NullMetrics", "merge_histograms", "read_flight",
+    "read_metrics",
 ]
 
 

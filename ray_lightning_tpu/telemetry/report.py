@@ -18,6 +18,15 @@ telemetry-enabled run left under ``<run_dir>/telemetry`` and prints:
 
 ``monitor <run_dir>`` is the live view: last span + current phase per
 rank and the partial goodput, one shot (or ``--follow``).
+``monitor <run_dir> --serve [--follow]`` renders the live SERVING tick
+stream instead — per-replica queue depth, decoding/prefilling slots,
+pool headroom, decode token rate, preemption/growth-stall counters,
+and the autoscale load signal, read from the per-tick metrics JSONL
+(telemetry/metrics.py). For serving runs ``report`` grows an SLO
+section: TTFT/TPOT/queue-wait p50/p95/p99 from the exactly-merged
+histogram buckets with the bucket sketch printed (tails are
+auditable), event counters, a per-replica timeline with restart
+markers, and the `load_signal()` summary.
 
 ``monitor --smoke`` is the format.sh gate (docs/OBSERVABILITY.md):
   1. telemetry=off pin — two tiny fits, recorder off vs on, must train
@@ -260,8 +269,18 @@ def build_serving_section(run_dir: str) -> Optional[Dict[str, Any]]:
     """Per-request serving latency attribution when this run dir holds
     serving telemetry (serve/driver.py): TTFT/TPOT percentiles from the
     per-request decode spans (or the driver's serving.json summary) +
-    replica restarts + aggregate throughput. None when the run served
-    nothing — training runs keep their report unchanged."""
+    replica restarts + aggregate throughput. When the run recorded
+    LIVE metrics (telemetry/metrics.py), the section grows the SLO
+    view: p99s computed from the exactly-merged histogram buckets, the
+    bucket sketches so tails are auditable, preemption / growth-stall
+    counts, queue-depth stats, the per-replica timeline (restart
+    markers = extra metrics files per replica), and the autoscale load
+    signal. None when the run served nothing — training runs keep
+    their report unchanged."""
+    from ray_lightning_tpu.telemetry.metrics import (
+        aggregate_from_parsed, load_signal_from_parsed,
+        newest_from_parsed, read_all_metrics,
+    )
     from ray_lightning_tpu.telemetry.spans import PH_DECODE, read_spans
 
     tdir = telemetry_dir(run_dir)
@@ -277,7 +296,10 @@ def build_serving_section(run_dir: str) -> Optional[Dict[str, Any]]:
     per_req: Dict[str, dict] = dict((summary or {}).get("meta", {}))
     if not per_req:
         # fall back to the span files: decode spans carry the request
-        # meta (rid, ttft_s, tpot_s) at completion
+        # meta (rid, ttft_s, tpot_s) at completion. Replayed-prefix and
+        # inflight-tagged spans carry neither ttft_s nor tpot_s, so a
+        # preempted request's discarded prefix can never double-count
+        # into the latency percentiles here.
         for path in sorted(glob.glob(
                 os.path.join(tdir, "rank*.spans.jsonl"))):
             try:
@@ -288,17 +310,22 @@ def build_serving_section(run_dir: str) -> Optional[Dict[str, Any]]:
                 meta = span.get("meta") or {}
                 if span.get("phase") == PH_DECODE and "ttft_s" in meta:
                     per_req[meta.get("rid", f"?{len(per_req)}")] = meta
-    if not per_req:
+    parsed_metrics = read_all_metrics(tdir)  # ONE parse pass for both
+    metrics_agg = aggregate_from_parsed(parsed_metrics)
+    if not per_req and not metrics_agg:
         return None
-    ttfts = sorted(float(m.get("ttft_s", 0.0)) for m in per_req.values())
-    tpots = sorted(float(m.get("tpot_s", 0.0)) for m in per_req.values())
-    section: Dict[str, Any] = {
-        "requests": len(per_req),
-        "ttft_p50_s": round(_pct(ttfts, 0.50), 4),
-        "ttft_p95_s": round(_pct(ttfts, 0.95), 4),
-        "tpot_p50_s": round(_pct(tpots, 0.50), 4),
-        "tpot_p95_s": round(_pct(tpots, 0.95), 4),
-    }
+    section: Dict[str, Any] = {"requests": len(per_req)}
+    if per_req:
+        ttfts = sorted(float(m.get("ttft_s", 0.0))
+                       for m in per_req.values())
+        tpots = sorted(float(m.get("tpot_s", 0.0))
+                       for m in per_req.values())
+        section.update({
+            "ttft_p50_s": round(_pct(ttfts, 0.50), 4),
+            "ttft_p95_s": round(_pct(ttfts, 0.95), 4),
+            "tpot_p50_s": round(_pct(tpots, 0.50), 4),
+            "tpot_p95_s": round(_pct(tpots, 0.95), 4),
+        })
     if summary:
         stats = summary.get("stats", {})
         for key in ("decode_tokens_per_s", "slot_occupancy",
@@ -308,6 +335,36 @@ def build_serving_section(run_dir: str) -> Optional[Dict[str, Any]]:
         restarts = summary.get("restarts", {})
         if restarts:
             section["replica_restarts"] = restarts
+    if metrics_agg:
+        lat = metrics_agg.get("latency") or {}
+        for name, key in (("ttft_s", "ttft"), ("tpot_s", "tpot"),
+                          ("queue_wait_s", "queue_wait")):
+            block = lat.get(name)
+            if not block:
+                continue
+            # bucket-derived quantiles override the sample-derived
+            # p50/p95 when present: they merge exactly across replicas
+            # and attempts, and they come with an auditable sketch
+            section[f"{key}_p50_s"] = block["p50"]
+            section[f"{key}_p95_s"] = block["p95"]
+            section[f"{key}_p99_s"] = block["p99"]
+            section[f"{key}_sketch"] = block["sketch"]
+            section[f"{key}_n"] = block["n"]
+        counters = metrics_agg.get("counters") or {}
+        section["counters"] = counters
+        if "queue_depth" in metrics_agg:
+            section["queue_depth"] = metrics_agg["queue_depth"]
+        # restart markers: each respawned attempt opened its own
+        # uid-tagged metrics file, so files - 1 = restarts observed
+        section["timeline"] = {
+            rep: {"attempts": info["files"],
+                  "restart_markers": info["files"] - 1,
+                  "ticks": info["ticks"],
+                  "last_tick_t": info["last_tick_t"]}
+            for rep, info in sorted(
+                (metrics_agg.get("replicas") or {}).items())}
+        section["load_signal"] = load_signal_from_parsed(
+            newest_from_parsed(parsed_metrics), where=tdir)
     return section
 
 
@@ -352,16 +409,57 @@ def _print_report(out: Dict[str, Any]) -> None:
               "supervised, or is still in flight)")
     sv = out.get("serving")
     if sv:
-        print(f"serving: {sv['requests']} request(s), TTFT p50 "
-              f"{sv['ttft_p50_s'] * 1e3:.1f} ms / p95 "
-              f"{sv['ttft_p95_s'] * 1e3:.1f} ms, TPOT p50 "
-              f"{sv['tpot_p50_s'] * 1e3:.1f} ms")
+        if "ttft_p99_s" in sv:
+            # the SLO line: quantiles from the exactly-merged histogram
+            # buckets (p99 included), auditable against the sketch
+            print(f"serving: {sv['requests']} request(s), TTFT p50 "
+                  f"{sv['ttft_p50_s'] * 1e3:.1f} / p95 "
+                  f"{sv['ttft_p95_s'] * 1e3:.1f} / p99 "
+                  f"{sv['ttft_p99_s'] * 1e3:.1f} ms, TPOT p50 "
+                  f"{sv['tpot_p50_s'] * 1e3:.1f} / p99 "
+                  f"{sv['tpot_p99_s'] * 1e3:.1f} ms (from merged "
+                  f"buckets, n={sv.get('ttft_n')})")
+            for key, label in (("ttft_sketch", "ttft"),
+                               ("queue_wait_sketch", "queue_wait")):
+                sk = sv.get(key)
+                if sk:
+                    buckets = " ".join(
+                        f"<={le * 1e3:.1f}ms:{c}" for le, c in sk)
+                    print(f"  {label} buckets: {buckets}")
+        elif "ttft_p50_s" in sv:
+            print(f"serving: {sv['requests']} request(s), TTFT p50 "
+                  f"{sv['ttft_p50_s'] * 1e3:.1f} ms / p95 "
+                  f"{sv['ttft_p95_s'] * 1e3:.1f} ms, TPOT p50 "
+                  f"{sv['tpot_p50_s'] * 1e3:.1f} ms")
+        else:
+            print(f"serving: {sv['requests']} request(s)")
         extras = ", ".join(
             f"{k}={sv[k]}" for k in ("decode_tokens_per_s",
                                      "slot_occupancy",
                                      "replica_restarts") if k in sv)
         if extras:
             print(f"  {extras}")
+        counters = sv.get("counters")
+        if counters:
+            qd = sv.get("queue_depth") or {}
+            print(f"  events: admissions={counters.get('admissions', 0)}"
+                  f" preemptions={counters.get('preemptions', 0)}"
+                  f" growth_stalls={counters.get('growth_stalls', 0)}"
+                  f" deferrals={counters.get('admission_deferrals', 0)}"
+                  + (f"; queue_depth p50={qd.get('p50')}"
+                     f" max={qd.get('max')}" if qd else ""))
+        for rep, tl in (sv.get("timeline") or {}).items():
+            marker = (f", {tl['restart_markers']} restart(s)"
+                      if tl.get("restart_markers") else "")
+            print(f"  replica {rep}: {tl['ticks']} tick(s) over "
+                  f"{tl['attempts']} attempt(s){marker}")
+        sig = sv.get("load_signal")
+        if sig and sig.get("available"):
+            print(f"  load signal: queue_depth now "
+                  f"{sig['queue_depth_now']:.0f} / p50 "
+                  f"{sig['queue_depth_p50']:.0f}, occupancy "
+                  f"{sig['occupancy']:.2f}, pressure "
+                  f"{sig['pressure'] if sig['pressure'] is not None else '—'}")
     ss = out.get("step_stats")
     if ss:
         print(f"warm step time: mean {ss['mean_s'] * 1e3:.2f} ms / "
@@ -420,6 +518,13 @@ def add_monitor_parser(sub) -> None:
     p.add_argument("--follow", action="store_true",
                    help="refresh every --interval seconds until ^C")
     p.add_argument("--interval", type=float, default=5.0)
+    p.add_argument("--serve", action="store_true",
+                   help="render the live SERVING tick stream instead "
+                        "of the training phase view: per-replica queue "
+                        "depth, slot/pool state, token rates, and the "
+                        "autoscale load signal from the per-tick "
+                        "metrics JSONL (docs/OBSERVABILITY.md "
+                        "'serving metrics')")
     p.add_argument("--smoke", action="store_true",
                    help="gate mode: telemetry=off byte-identical pin, "
                         "2-proc fault-injected goodput report (buckets "
@@ -456,6 +561,81 @@ def _monitor_once(run_dir: str) -> Dict[str, Any]:
     return view
 
 
+def _monitor_serve_once(run_dir: str) -> Dict[str, Any]:
+    """One sample of the live serving view: the newest metrics file per
+    replica, its latest flushed tick, a token rate over the recent
+    window, and the load signal — everything `monitor --serve` renders.
+    Reads only flushed JSONL, so the view lags live state by at most
+    one flush cadence."""
+    from ray_lightning_tpu.telemetry.metrics import (
+        load_signal_from_parsed, newest_metrics_per_replica,
+    )
+
+    tdir = telemetry_dir(run_dir)
+    view: Dict[str, Any] = {"run_dir": run_dir, "replicas": {}}
+    # ONE parse pass serves both the per-replica view and the load
+    # signal — a --follow refresh re-reads each file once, not twice
+    newest = newest_metrics_per_replica(tdir)
+    now = time.time()
+    for rep, entry in sorted(newest.items()):
+        parsed = entry["parsed"]
+        ticks = parsed["ticks"]
+        last = ticks[-1] if ticks else {}
+        g = dict(last.get("g") or {})
+        c = dict(last.get("c") or {})
+        rate = None
+        if len(ticks) >= 2:
+            # decode rate over the flushed window: counter delta / time
+            first = ticks[max(0, len(ticks) - 64)]
+            dt = float(last.get("t", 0.0)) - float(first.get("t", 0.0))
+            dtok = (int((last.get("c") or {}).get("decode_tokens", 0))
+                    - int((first.get("c") or {}).get("decode_tokens",
+                                                     0)))
+            if dt > 0:
+                rate = dtok / dt
+        age = None
+        if ticks and entry["t0"]:
+            age = now - (entry["t0"] + float(last.get("t", 0.0)))
+        view["replicas"][rep] = {
+            "tick": last.get("tick"),
+            "age_s": round(age, 1) if age is not None else None,
+            "queue_depth": g.get("queue_depth"),
+            "decoding": g.get("decoding_slots"),
+            "prefilling": g.get("prefilling_slots"),
+            "blocks_free": g.get("blocks_free"),
+            "decode_tokens_per_s": round(rate, 1) if rate else None,
+            "preemptions": c.get("preemptions", 0),
+            "growth_stalls": c.get("growth_stalls", 0),
+            "compile_count": g.get("compile_count"),
+        }
+    view["load_signal"] = load_signal_from_parsed(newest, where=tdir)
+    return view
+
+
+def _print_serve_view(view: Dict[str, Any]) -> None:
+    print(f"-- {time.strftime('%H:%M:%S')} {view['run_dir']} (serving)")
+    for rep, r in view["replicas"].items():
+        rate = (f" {r['decode_tokens_per_s']} tok/s"
+                if r.get("decode_tokens_per_s") else "")
+        print(f"  replica {rep}: tick {r['tick']} "
+              f"({r['age_s']}s ago) queue={r['queue_depth']} "
+              f"decoding={r['decoding']} prefilling={r['prefilling']} "
+              f"blocks_free={r['blocks_free']}{rate} "
+              f"preempt={r['preemptions']} "
+              f"stalls={r['growth_stalls']}")
+    if not view["replicas"]:
+        print("  (no metrics files yet)")
+    sig = view.get("load_signal") or {}
+    if sig.get("available"):
+        pressure = sig.get("pressure")
+        print(f"  load: queue now {sig['queue_depth_now']:.0f} / p50 "
+              f"{sig['queue_depth_p50']:.0f} / max "
+              f"{sig['queue_depth_max']:.0f}, occupancy "
+              f"{sig['occupancy']:.2f}"
+              + (f", pressure {pressure:.2f}"
+                 if pressure is not None else ""))
+
+
 def run_monitor(args) -> int:
     if args.smoke:
         return _run_smoke(args)
@@ -463,6 +643,16 @@ def run_monitor(args) -> int:
         print("error: pass a run dir or --smoke", file=sys.stderr)
         return 2
     as_json = getattr(args, "as_json", False)
+    if getattr(args, "serve", False):
+        while True:
+            view = _monitor_serve_once(args.run_dir)
+            if as_json:
+                print(json.dumps(view), flush=True)
+            else:
+                _print_serve_view(view)
+            if not args.follow:
+                return 0
+            time.sleep(max(0.2, args.interval))
     while True:
         view = _monitor_once(args.run_dir)
         if as_json:

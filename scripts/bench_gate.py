@@ -20,6 +20,13 @@ Bounded metrics (upper limits, not ratchets):
                                  to the step time — must stay < 1%
                                  (ISSUE 7: observability must not
                                  become the overhead it measures)
+    ttft_warm_s                  warm single-request TTFT (ISSUE 8)
+    ttft_p99_s                   steady-state warm TTFT p99 from the
+                                 mergeable histogram buckets (ISSUE 12
+                                 serving metrics; RLT_BENCH_TTFT_P99_MAX
+                                 overrides, skip/null waives)
+    reshard_restore_s            elastic cross-topology restore wall
+                                 (ISSUE 9)
 
 Gate semantics:
 
@@ -114,6 +121,14 @@ BOUNDED = {
     # hot path.
     "ttft_warm_s": float(
         os.environ.get("RLT_BENCH_TTFT_WARM_MAX", 2.0)),
+    # warm TTFT p99 (serving metrics leg, ISSUE 12): the tail of the
+    # steady-state admission->first-token latency, read from the
+    # mergeable histogram BUCKETS (telemetry/metrics.py) — the SLO
+    # number production serving is judged on. Looser than the warm
+    # mean bound: the p99 request admitted behind a full slot set
+    # waits out its predecessors' prefill chunks by design.
+    "ttft_p99_s": float(
+        os.environ.get("RLT_BENCH_TTFT_P99_MAX", 5.0)),
     # cross-topology restore (elastic leg, ISSUE 9): the wall seconds
     # one elastic shrink/grow pays to reshard its ~32 MiB probe state.
     # A growth here means the reshard path started gathering to host
@@ -289,10 +304,18 @@ def gate(fresh: dict, best: dict, tolerance: float,
             failures.append(f"{key}: non-numeric value {v!r}")
             continue
         if v > bound:
-            what = ("telemetry is eating the step time it exists to "
-                    "measure" if key == "telemetry_overhead_fraction"
-                    else "the serving warm path regressed (recompile "
-                    "or prefill growth on the request hot path)")
+            whats = {
+                "telemetry_overhead_fraction":
+                    "telemetry is eating the step time it exists to "
+                    "measure",
+                "ttft_p99_s":
+                    "the steady-state TTFT tail blew its SLO bound — "
+                    "queueing/prefill latency grew on the serving hot "
+                    "path (see the histogram sketch in `report`)",
+            }
+            what = whats.get(
+                key, "the serving warm path regressed (recompile "
+                     "or prefill growth on the request hot path)")
             failures.append(
                 f"{key}: {v:g} exceeds the {bound:g} upper bound — "
                 f"{what}")

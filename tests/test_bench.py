@@ -398,7 +398,8 @@ def test_skip_line_carries_serving_schema(monkeypatch, capsys):
     assert serving is not None, obj.get("serving_error")
     assert set(serving["schema"]) == {
         "decode_tokens_per_s", "ttft_cold_s", "ttft_warm_s",
-        "slot_occupancy", "serving_attention_path"}
+        "ttft_p99_s", "slot_occupancy", "serving_attention_path",
+        "serve_metrics"}
     assert serving["flagship_plan"]["pool_bytes"] > 0
     # measured serving values belong to success lines only
     assert "decode_tokens_per_s" not in obj

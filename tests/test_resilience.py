@@ -66,6 +66,31 @@ def test_classify_backend_loss_in_worker_as_retryable():
     assert classify_failure(err).kind == FailureKind.RETRYABLE
 
 
+def test_classify_collective_peer_loss_as_retryable():
+    """A surviving rank whose collective dies because its PEER was
+    killed must classify like the peer's death itself — which rank's
+    failure reaches the driver first is a race (observed: the kill
+    drill flaking FATAL when the survivor's gloo error won)."""
+    err = WorkerError(0, "Traceback ...\njaxlib.xla_extension."
+                         "XlaRuntimeError: FAILED_PRECONDITION: Buffer "
+                         "Definition Event: Gloo all-reduce failed: "
+                         "[gloo/transport/tcp/pair.cc:534] Connection "
+                         "closed by peer [127.0.0.1]:14000")
+    fc = classify_failure(err)
+    assert fc.kind == FailureKind.RETRYABLE
+    assert fc.restartable
+    # lowercase transport-path variants too
+    low = WorkerError(0, "Traceback ...\ngloo::IoException: "
+                         "[gloo/transport/tcp/pair.cc:598] Timed out "
+                         "waiting for clients")
+    assert classify_failure(low).kind == FailureKind.RETRYABLE
+    # but a deterministic bug RAISING THROUGH a collective is still
+    # fatal — the marker is the transport path, not the word "gloo"
+    bug = WorkerError(0, "Traceback ...\ngloo::EnforceNotMet: "
+                         "invalid tensor size mismatch")
+    assert classify_failure(bug).kind == FailureKind.FATAL
+
+
 def test_classify_preempted_drain_as_preemption():
     err = WorkerError(1, "Traceback ...\nray_lightning_tpu.resilience."
                          "preempt.PreemptedError: training drained after "

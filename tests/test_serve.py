@@ -400,7 +400,8 @@ def test_bench_serve_summary_static():
     assert s["serving"]["flagship_plan"]["pool_bytes"] > 0
     assert set(s["serving"]["schema"]) == {
         "decode_tokens_per_s", "ttft_cold_s", "ttft_warm_s",
-        "slot_occupancy", "serving_attention_path"}
+        "ttft_p99_s", "slot_occupancy", "serving_attention_path",
+        "serve_metrics"}
 
 
 def test_bench_gate_ratchets_serving(tmp_path):

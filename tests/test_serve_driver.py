@@ -177,3 +177,13 @@ def test_process_replica_kill_respawns_and_replays(setup, tmp_path):
         np.testing.assert_array_equal(np.array(res.outputs[rid]), ref,
                                       err_msg=rid)
     assert res.stats["warmup_respawn_s"] is not None
+    # the flight-recorder postmortem (telemetry/metrics.py): the driver
+    # finalized the dead replica's last persisted ring into flight.json
+    # with the resilience classification stamped on
+    with open(str(tmp_path / "run" / "flight.json")) as f:
+        doc = json.load(f)
+    dump = doc["dumps"][0]
+    assert dump["replica"] == 1
+    assert dump["death"]["kind"] == "retryable"
+    assert dump["death"]["respawning"] is True
+    assert any(e.get("kind") == "tick" for e in dump["events"])
