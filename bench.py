@@ -487,7 +487,16 @@ def _serve_summary() -> dict:
         return {"serving": {
             "schema": ["decode_tokens_per_s", "ttft_cold_s",
                        "ttft_warm_s", "ttft_p99_s", "slot_occupancy",
-                       "serving_attention_path", "serve_metrics"],
+                       "serving_attention_path", "serve_metrics",
+                       "scale_up_s", "autoscale"],
+            "autoscale_schema": {
+                "scale_up_s": "wall seconds one controller-driven "
+                              "add_replica pays (spawn + weights + "
+                              "step warm; bench_gate bounds it via "
+                              "RLT_BENCH_SCALE_UP_MAX)",
+                "decisions": "controller polls in the drill",
+                "final_replicas": "replica count after the drill",
+            },
             "engine": "paged-kv continuous-batching (serve/)",
             "source": "static-schema",
             "flagship_plan": plan,
@@ -501,12 +510,16 @@ def _serve_summary() -> dict:
         return {"serving_error": f"{type(exc).__name__}: {str(exc)[:200]}"}
 
 
-def _measure_serving(tiny: bool | None = None) -> dict:
+def _measure_serving(tiny: bool | None = None,
+                     autoscale: bool = True) -> dict:
     """Measured serving leg (bench success lines + unit tests).
 
     ``tiny=None`` auto-sizes: the 0.5B-class bench model on an
     accelerator, the laptop-sized tiny config on CPU (unit tests /
     RLT_BENCH_SERVE_TINY=1) — same engine code path either way.
+    ``autoscale=False`` skips the scale-up/down drill (unit tests of
+    the throughput/TTFT fields alone — the drill pays two extra engine
+    compiles; real bench lines always run it).
     """
     import time as _time
 
@@ -579,7 +592,10 @@ def _measure_serving(tiny: bool | None = None) -> dict:
                 for s in reg.ring())
     ttft_hist = reg.histogram("ttft_s")
     ttft_p99 = ttft_hist.quantile(0.99) if ttft_hist else None
+    autoscale_fields = (_measure_autoscale(cfg, ecfg, params)
+                        if autoscale else {})
     return {
+        **autoscale_fields,
         "decode_tokens_per_s": round(n_tokens / max(wall, 1e-9), 2),
         "ttft_cold_s": round(ttft_cold, 4),
         "ttft_warm_s": round(ttft_warm, 4),
@@ -601,6 +617,74 @@ def _measure_serving(tiny: bool | None = None) -> dict:
             "ticks": reg.ticks,
         },
     }
+
+
+def _measure_autoscale(cfg, ecfg, params) -> dict:
+    """Autoscale actuation drill (autoscale/, docs/AUTOSCALE.md,
+    ISSUE 13): one controller-driven scale-up then scale-down on the
+    SAME model/engine shape as the serving leg. ``scale_up_s`` is the
+    wall one `add_replica` pays through the controller seam — the
+    respawn path: weights + step compile (or persistent-cache
+    deserialize) + warmup — the latency a pressure spike waits before
+    capacity actually arrives. bench_gate upper-bounds it
+    (RLT_BENCH_SCALE_UP_MAX). A drill failure degrades to
+    ``autoscale_error`` — the serving measurements must never die with
+    it."""
+    import shutil
+    import tempfile
+
+    try:
+        from ray_lightning_tpu.autoscale import (
+            AutoscaleController, ControllerConfig, PolicyConfig,
+        )
+        from ray_lightning_tpu.serve.driver import (
+            ReplicaGroupConfig, ServeDriver,
+        )
+
+        as_dir = tempfile.mkdtemp(prefix="rlt_bench_autoscale_")
+        try:
+            drv = ServeDriver(cfg, params, ReplicaGroupConfig(
+                n_replicas=1, engine=ecfg, run_dir=as_dir,
+                metrics_flush_every_n_ticks=2))
+            drv.start()
+            # fabricated signals isolate the drill to ACTUATION cost —
+            # the signal path itself is the smoke/tests' business
+            high = {"available": True, "pressure": 2.0,
+                    "queue_depth_now": float(2 * ecfg.capacity),
+                    "occupancy": 1.0,
+                    "total_slots": float(ecfg.capacity)}
+            low = {"available": True, "pressure": 0.0,
+                   "queue_depth_now": 0.0, "occupancy": 0.0,
+                   "total_slots": float(2 * ecfg.capacity)}
+            sigs = [dict(high), dict(low)]
+            ctl = AutoscaleController(
+                drv,
+                ControllerConfig(policy=PolicyConfig(
+                    min_replicas=1, max_replicas=2, sustain_polls=1,
+                    up_cooldown_s=0.0, down_cooldown_s=0.0)),
+                signal_fn=lambda: (sigs.pop(0) if len(sigs) > 1
+                                   else dict(sigs[0])))
+            ctl.step(now=0.0)     # scale up: the measured spawn
+            ctl.step(now=100.0)   # scale down: graceful drain
+            result = drv.stop()
+            return {
+                "scale_up_s": (round(ctl.scale_up_s[0], 4)
+                               if ctl.scale_up_s else None),
+                "autoscale": {
+                    "scale_up_s": (round(ctl.scale_up_s[0], 4)
+                                   if ctl.scale_up_s else None),
+                    "decisions": ctl.decisions,
+                    "scale_ups": ctl.scale_ups,
+                    "scale_downs": ctl.scale_downs,
+                    "final_replicas":
+                        result.stats["final_replicas"],
+                },
+            }
+        finally:
+            shutil.rmtree(as_dir, ignore_errors=True)
+    except Exception as exc:  # noqa: BLE001 — advisory drill only
+        return {"autoscale_error":
+                f"{type(exc).__name__}: {str(exc)[:200]}"}
 
 
 def _kill_line(signame: str) -> str:

@@ -106,6 +106,21 @@ JAX_PLATFORMS=cpu python -m ray_lightning_tpu monitor --smoke > /dev/null
 # (no RLT301/RLT303).
 JAX_PLATFORMS=cpu python -m ray_lightning_tpu serve --smoke > /dev/null
 
+# autoscale gate (docs/AUTOSCALE.md): under a deterministic scripted
+# load ramp (virtual-tick clock — no wall-clock flakiness) the
+# closed-loop controller must scale 1 -> 2 on sustained pressure and
+# back to 1 on idle, exactly once each (hysteresis + cooldowns honored
+# across ~36 polls), record EVERY decision with its signal snapshot in
+# a parseable autoscale.jsonl, and complete every stream
+# bitwise-identical to single-stream generate() — a graceful drain
+# drops nothing; a capacity-oracle probe file must clamp a wanted
+# scale-up with the oracle's answer in the ledger; an injected
+# SIGKILL-class spawn death mid-scale-up must be classified via the
+# resilience taxonomy and retried within budget without dropping the
+# scale target; and submit() with every replica draining must defer
+# with a structured reason instead of routing onto a stopping replica.
+JAX_PLATFORMS=cpu python -m ray_lightning_tpu autoscale --smoke > /dev/null
+
 # elastic gate (docs/ELASTIC.md): an 8-device fsdp=8 CPU-SPMD
 # checkpoint must reshard-restore onto a 4-device fsdp=4 mesh with
 # every param/opt-state leaf BITWISE-equal to the source, and training

@@ -66,6 +66,17 @@ the survivor world and converge:
 
     python -m ray_lightning_tpu elastic --smoke
 
+``autoscale`` runs the closed-loop serving autoscaler (autoscale/,
+docs/AUTOSCALE.md): a pressure-band policy polling the serving load
+signal and actuating replica count through the ServeDriver scaling
+seams, with every decision in an append-only ledger. ``--smoke`` is
+the format.sh gate (scripted ramp scales 1 -> 2 -> 1 with bitwise
+streams, a capacity clamp + SIGKILL-absorbing spawn drill, and the
+all-draining submit deferral):
+
+    python -m ray_lightning_tpu autoscale
+    python -m ray_lightning_tpu autoscale --smoke
+
 ``report`` / ``monitor`` read the telemetry a run left behind
 (telemetry/, docs/OBSERVABILITY.md): the goodput classification of
 supervised wall time, per-rank span timelines, and — with
@@ -518,6 +529,9 @@ def main(argv=None) -> int:
     from ray_lightning_tpu.analysis.cli import (
         add_lint_parser, add_trace_parser, run_lint, run_trace,
     )
+    from ray_lightning_tpu.autoscale.cli import (
+        add_autoscale_parser, run_autoscale,
+    )
     from ray_lightning_tpu.elastic.cli import (
         add_elastic_parser, run_elastic,
     )
@@ -538,6 +552,7 @@ def main(argv=None) -> int:
     add_report_parser(sub)
     add_monitor_parser(sub)
     add_elastic_parser(sub)
+    add_autoscale_parser(sub)
     args = p.parse_args(argv)
     if args.cmd == "plan":
         return run_plan(args)
@@ -557,6 +572,8 @@ def main(argv=None) -> int:
         return run_monitor(args)
     if args.cmd == "elastic":
         return run_elastic(args)
+    if args.cmd == "autoscale":
+        return run_autoscale(args)
     info = collect(probe=args.probe)
     if args.as_json:
         print(json.dumps(info))

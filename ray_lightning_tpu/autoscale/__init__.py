@@ -1,0 +1,39 @@
+"""Closed-loop serving autoscale (docs/AUTOSCALE.md): a pure decision
+core (`policy`), the capacity oracle shared with the elastic training
+ladder (`capacity`), the actuator driving `ServeDriver` scaling seams
+with an append-only decision ledger (`controller`), and the
+deterministic scripted-load harness (`sim`)."""
+from ray_lightning_tpu.autoscale.capacity import (
+    CapacityAnswer,
+    CapacityOracle,
+    default_oracle,
+    spawn_probe,
+)
+from ray_lightning_tpu.autoscale.controller import (
+    AutoscaleController,
+    ControllerConfig,
+    read_ledger,
+)
+from ray_lightning_tpu.autoscale.policy import (
+    Decision,
+    PolicyConfig,
+    PolicyState,
+    decide,
+)
+from ray_lightning_tpu.autoscale.sim import ScriptedLoad, run_scripted
+
+__all__ = [
+    "AutoscaleController",
+    "CapacityAnswer",
+    "CapacityOracle",
+    "ControllerConfig",
+    "Decision",
+    "PolicyConfig",
+    "PolicyState",
+    "ScriptedLoad",
+    "decide",
+    "default_oracle",
+    "read_ledger",
+    "run_scripted",
+    "spawn_probe",
+]

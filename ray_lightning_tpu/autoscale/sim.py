@@ -1,0 +1,62 @@
+"""Deterministic scripted-load harness: the control loop on a virtual
+clock.
+
+Wall-clock autoscale tests flake by construction — pressure depends on
+when the poll landed relative to the flush cadence. This harness makes
+the whole loop a pure function of the script: the DRIVER TICK COUNTER
+is the clock (1 tick = 1 virtual second for the policy's cooldown
+arithmetic), arrivals fire at scripted ticks, the controller polls
+every ``poll_every_ticks`` ticks, and the load signal is read from the
+same flushed metrics files production reads — so the smoke/test
+exercises the real signal path, the real policy, and the real
+`ServeDriver` seams with zero sleeps and zero wall-clock sensitivity
+(tests/test_autoscale.py, ``autoscale --smoke``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["ScriptedLoad", "run_scripted"]
+
+
+@dataclasses.dataclass
+class ScriptedLoad:
+    """``arrivals[tick]`` = requests submitted at that virtual tick."""
+
+    arrivals: Dict[int, Sequence]
+    #: keep ticking (and polling) this many ticks after the last
+    #: stream drains — the idle phase a scale-down needs to observe
+    idle_ticks_after_drain: int = 48
+
+    def last_arrival_tick(self) -> int:
+        return max(self.arrivals) if self.arrivals else 0
+
+
+def run_scripted(driver, controller, load: ScriptedLoad,
+                 poll_every_ticks: int = 2,
+                 max_ticks: int = 5000) -> dict:
+    """Drive one scripted serving session to completion. The driver
+    session must be `start()`ed. Returns
+    ``{"ticks", "drained_at", "entries"}`` where ``entries`` is every
+    controller ledger entry in order."""
+    entries: List[dict] = []
+    drained_at: Optional[int] = None
+    last_arrival = load.last_arrival_tick()
+    tick = 0
+    while tick < max_ticks:
+        for req in load.arrivals.get(tick, ()):
+            driver.submit(req)
+        driver.tick()
+        if tick % poll_every_ticks == 0:
+            entries.append(controller.step(now=float(tick)))
+        if tick >= last_arrival and not driver.busy():
+            if drained_at is None:
+                drained_at = tick
+            if tick - drained_at >= load.idle_ticks_after_drain:
+                break
+        else:
+            drained_at = None
+        tick += 1
+    return {"ticks": tick, "drained_at": drained_at,
+            "entries": entries}

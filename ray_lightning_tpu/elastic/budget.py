@@ -30,6 +30,13 @@ it (`Trainer(accumulate_grad_batches=...)`) and whether it is whole;
 the supervisor records the plan in its reshard ledger either way, so a
 silently changed effective batch can never masquerade as a seamless
 resume.
+
+Capacity comes from the SHARED oracle (`autoscale/capacity.py`,
+docs/AUTOSCALE.md) — the same truth the serving autoscaler's clamp
+reads: `RLT_CAPACITY` env override, probe file, optional WorkerGroup
+spawn probe, with the resolved-max fallback LABELED ``assumed`` so the
+supervisor can record the honesty gap in the reshard ledger when a
+grow is refused (the old silent assume-restored default is retired).
 """
 from __future__ import annotations
 
@@ -64,12 +71,19 @@ class ElasticBudget:
     global_batch: Optional[int] = None
     #: how many topology changes (shrinks + grows) the run may perform
     max_reshards: int = 4
-    #: capacity oracle: () -> currently available world size. None =
-    #: capacity is assumed back at max after every failure, so the
-    #: supervisor GROWS on the next relaunch once a shrink happened
-    #: only if a larger size is legal AND a restart occurs. Provide a
-    #: real probe (scheduler API, preemption notices) in production.
+    #: legacy capacity hook: () -> currently available world size.
+    #: Takes precedence over the oracle when set (back-compat).
     capacity_fn: Optional[Callable[[], int]] = None
+    #: the capacity oracle (autoscale/capacity.py) — the SAME truth the
+    #: serving autoscale controller consults: RLT_CAPACITY env
+    #: override, probe file, optional WorkerGroup spawn probe. None =
+    #: the process-wide default oracle (env + file). When NO source
+    #: answers, the oracle falls back to the resolved max but LABELS
+    #: it (source="assumed") — the supervisor records that label in
+    #: the reshard ledger on a refused grow, so an assumption can
+    #: never masquerade as a measurement (the retired silent
+    #: assume-restored default).
+    oracle: Optional[Any] = None
 
     def resolved_max(self, launch_world: int) -> int:
         return self.max_world if self.max_world is not None \
@@ -111,16 +125,41 @@ class ElasticBudget:
                 return w
         return None
 
-    def capacity(self, launch_world: int) -> int:
-        """Currently available world size per the oracle (falls back to
-        the resolved max: capacity assumed restored)."""
+    def capacity_answer(self, launch_world: int):
+        """The capacity oracle's full answer (worlds + source +
+        detail) — what the supervisor stamps into the reshard ledger
+        when a grow is refused. Resolution: the legacy ``capacity_fn``
+        when set, else the configured/shared `CapacityOracle`
+        (env -> probe file -> optional spawn probe), else the resolved
+        max LABELED ``source="assumed"``."""
+        from ray_lightning_tpu.autoscale.capacity import (
+            CapacityAnswer, default_oracle,
+        )
+
         if self.capacity_fn is not None:
             try:
-                return max(0, int(self.capacity_fn()))
-            except Exception:  # noqa: BLE001 — a broken oracle must not
-                # kill the supervisor; assume nothing came back
-                return 0
-        return self.resolved_max(launch_world)
+                return CapacityAnswer(max(0, int(self.capacity_fn())),
+                                      "capacity_fn")
+            except Exception as exc:  # noqa: BLE001 — a broken oracle
+                # must not kill the supervisor; nothing came back
+                return CapacityAnswer(
+                    0, "capacity_fn",
+                    f"oracle raised {type(exc).__name__}: "
+                    f"{str(exc)[:200]}")
+        oracle = self.oracle if self.oracle is not None \
+            else default_oracle()
+        return oracle.query(assume=self.resolved_max(launch_world))
+
+    def capacity(self, launch_world: int) -> int:
+        """Currently available world size per `capacity_answer`. The
+        built-in chain always answers (the assume= fallback is the
+        labeled resolved max); the None guard exists only for a
+        user-supplied ``oracle`` whose query() ignores ``assume`` —
+        such an oracle's silence reads as the historical
+        assumed-restored value, never as zero."""
+        worlds = self.capacity_answer(launch_world).worlds
+        return worlds if worlds is not None \
+            else self.resolved_max(launch_world)
 
     def batch_plan(self, old_world: int, new_world: int) -> Dict[str, Any]:
         """The honest batch story of a world change. When the global

@@ -274,7 +274,7 @@ def run_elastic(args) -> int:
               f"leaves={r.get('leaves')} "
               f"continued_acc={r.get('continued_val_accuracy')}")
         print(f"  shrink:  {'ok' if s.get('ok') else 'FAILED'} "
-              f"reshards={[(e['from_world'], e['to_world']) for e in s.get('reshards') or []]} "
+              f"reshards={[(e['from_world'], e.get('to_world')) for e in s.get('reshards') or []]} "
               f"acc={s.get('val_accuracy')} "
               f"reshard_bucket={s.get('reshard_bucket_present')}")
         for leg in ("reshard", "shrink"):

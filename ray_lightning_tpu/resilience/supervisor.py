@@ -141,8 +141,13 @@ class SupervisedResult:
     @property
     def final_world(self) -> Optional[int]:
         """World size of the attempt that finished (None = unchanged
-        from launch)."""
-        return self.reshards[-1]["to_world"] if self.reshards else None
+        from launch). Only ACTUAL world changes count — the ledger
+        also records ``grow_refused`` entries (capacity-oracle
+        refusals, docs/AUTOSCALE.md) which carry no ``to_world``."""
+        for entry in reversed(self.reshards):
+            if entry.get("reason") in ("shrink", "grow"):
+                return entry["to_world"]
+        return None
 
     @property
     def total_attempts(self) -> int:
@@ -414,10 +419,26 @@ def supervise(
                 # same-size relaunch becomes a SHRINK onto the largest
                 # legal survivor world; an allowed relaunch whose
                 # capacity oracle reports a different size moves toward
-                # it (growth back when capacity returns)
-                new_world = _elastic_target_world(
-                    cfg.elastic, world, launch_world, allowed,
-                    len(reshards))
+                # it (growth back when capacity returns). Only ACTUAL
+                # world changes spend max_reshards — refusal records in
+                # the ledger are free.
+                spent = sum(1 for e in reshards
+                            if e.get("reason") in ("shrink", "grow"))
+                new_world, grow_refusal = _elastic_decision(
+                    cfg.elastic, world, launch_world, allowed, spent)
+                if grow_refusal is not None:
+                    # the oracle kept a shrunk run small: record its
+                    # answer (worlds + source) in the reshard ledger —
+                    # the capacity truth is auditable, never implicit
+                    reshards.append({**grow_refusal,
+                                     "attempt": attempts,
+                                     "at": time.time()})
+                    log.warning(
+                        "supervise: grow %d -> %d refused — capacity "
+                        "oracle (%s) reports %s schedulable world(s)",
+                        world, grow_refusal["resolved_max"],
+                        grow_refusal["capacity_source"],
+                        grow_refusal["capacity"])
             if new_world is None and not allowed:
                 _assemble(restarts, preemptions, rollbacks)
                 raise RestartBudgetExceeded(
@@ -483,6 +504,14 @@ def supervise(
 def _elastic_target_world(budget, world: int, launch_world: int,
                           allowed: bool,
                           reshards_done: int) -> Optional[int]:
+    """Back-compat wrapper over `_elastic_decision`: just the target
+    world (tests and external callers keep their contract)."""
+    return _elastic_decision(budget, world, launch_world, allowed,
+                             reshards_done)[0]
+
+
+def _elastic_decision(budget, world: int, launch_world: int,
+                      allowed: bool, reshards_done: int):
     """The elastic supervision decision (docs/ELASTIC.md): given the
     current world, whether the retry policy still allows a SAME-SIZE
     relaunch, and how many topology changes were already spent, pick
@@ -496,20 +525,41 @@ def _elastic_target_world(budget, world: int, launch_world: int,
         toward it (this is how a shrunk run grows back — the next
         relaunch after capacity returns resumes at the bigger world).
 
+    Returns ``(target, grow_refusal)``: ``target`` is None for "no
+    change"; ``grow_refusal`` is a ledger-shaped dict when the run sits
+    BELOW its resolved max and the capacity oracle's answer is what
+    kept it there — the supervisor records the oracle's answer (worlds
+    + source, docs/AUTOSCALE.md "capacity oracle") in the reshard
+    ledger so a run that stayed small has its reason on the record.
+
     Never proposes the current world, never exceeds max_reshards, and
     only proposes rungs `ElasticBudget.legal` accepts (divisibility via
     the plan checker's own MeshSpec/dp_degree machinery)."""
     if budget is None or reshards_done >= budget.max_reshards:
-        return None
-    cap = min(budget.capacity(launch_world),
-              budget.resolved_max(launch_world))
+        return None, None
+    answer = budget.capacity_answer(launch_world)
+    raw_cap = answer.worlds if answer.worlds is not None \
+        else budget.resolved_max(launch_world)
+    cap = min(raw_cap, budget.resolved_max(launch_world))
     if not allowed:
-        return budget.largest_legal(min(cap, world - 1), launch_world)
+        return (budget.largest_legal(min(cap, world - 1), launch_world),
+                None)
     if cap != world:
         target = budget.largest_legal(cap, launch_world)
         if target is not None and target != world:
-            return target
-    return None
+            return target, None
+    if world < budget.resolved_max(launch_world) and cap <= world:
+        # a shrunk run could grow but the oracle says capacity has not
+        # returned: refuse, and say WHO said so
+        return None, {
+            "reason": "grow_refused",
+            "from_world": world,
+            "resolved_max": budget.resolved_max(launch_world),
+            "capacity": raw_cap,
+            "capacity_source": answer.source,
+            "capacity_detail": answer.detail,
+        }
+    return None, None
 
 
 def _begin_reshard(cfg: ResilienceConfig, world: int, new_world: int,

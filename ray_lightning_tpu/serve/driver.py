@@ -41,6 +41,16 @@ dead replica's flight ring into ``<run_dir>/flight.json`` stamped with
 the resilience classification, and exposes `load_signal(run_dir)` —
 the queue-depth/occupancy oracle input ROADMAP item 1(c) autoscale
 consumes.
+
+Dynamic serving session (docs/AUTOSCALE.md): beyond the fixed-batch
+``run()``, `start()` opens a LIVE session with the autoscale actuation
+seams — ``submit()`` (routes to live replicas; defers with a
+structured reason when every replica is draining/dead), ``tick()``,
+``add_replica()`` (exactly the respawn path: npz reload + persistent
+compile-cache re-warm), ``remove_replica(graceful=True)`` (stop
+admissions, drain slots to retirement, requeue queued work onto
+survivors via the bitwise replay seam), ``stop()``. The
+`autoscale.AutoscaleController` drives these from the load signal.
 """
 from __future__ import annotations
 
@@ -49,6 +59,7 @@ import json
 import os
 import signal
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -397,6 +408,25 @@ def _replica_worker_main(model_cfg_kw: dict, params_path: str,
 
 # ---- the driver ------------------------------------------------------------
 
+class _Replica:
+    """One inline replica in a dynamic serving session: engine +
+    scheduler + recorder and a three-state lifecycle
+    (live -> draining -> stopped)."""
+
+    __slots__ = ("id", "engine", "sched", "recorder", "state",
+                 "spawned_at", "warm_s")
+
+    def __init__(self, rid: int, engine, sched, recorder,
+                 warm_s: float):
+        self.id = rid
+        self.engine = engine
+        self.sched = sched
+        self.recorder = recorder
+        self.state = "live"
+        self.spawned_at = time.perf_counter()
+        self.warm_s = warm_s
+
+
 class ServeDriver:
     """Multiplex request streams over ``cfg.n_replicas`` replicas.
 
@@ -417,6 +447,21 @@ class ServeDriver:
             raise ValueError(
                 "process replicas need a params .npz path "
                 "(save_params_npz) — the respawn path reloads from it")
+        # ---- dynamic serving session state (docs/AUTOSCALE.md) ----
+        self._session_active = False
+        self.replicas: Dict[int, "_Replica"] = {}
+        self._next_replica = 0
+        self._rr = 0
+        #: requests with no live replica to route to — the structured
+        #: deferral queue (never round-robined onto a draining replica)
+        self.pending: Optional[deque] = None
+        self.outputs = {}
+        self.meta = {}
+        self.last_deferral: Optional[dict] = None
+        self._spawn_faults: List[dict] = []
+        self.last_spawn_s: Optional[float] = None
+        self.driver_metrics = None
+        self.driver_flight = None
 
     def _metrics_cfg(self) -> dict:
         return {"enabled": self.cfg.metrics,
@@ -675,6 +720,417 @@ class ServeDriver:
                                  "die for real to drill recovery")
             return self._run_inline(requests, fault)
         return self._run_process(requests, fault)
+
+    # ---- dynamic serving session: the autoscale actuation seams ----------
+    # (docs/AUTOSCALE.md). `run()` above serves a FIXED batch over a
+    # FIXED replica set; the session below keeps the driver live so a
+    # controller can add/remove replicas while requests flow. Inline
+    # backend only today: process replicas already own the spawn/
+    # reload/re-warm machinery these seams reuse (the respawn path),
+    # but a dynamically-scaled process pool needs a driver->worker
+    # request channel the runtime does not have yet — stated in
+    # docs/AUTOSCALE.md, not hidden.
+
+    def _require_session(self) -> None:
+        if not self._session_active:
+            raise RuntimeError(
+                "no serving session — call ServeDriver.start() first "
+                "(run() is the fixed-batch mode and has no scaling "
+                "seams)")
+
+    @property
+    def live_ids(self) -> List[int]:
+        return sorted(r.id for r in self.replicas.values()
+                      if r.state == "live")
+
+    @property
+    def n_live(self) -> int:
+        return len(self.live_ids)
+
+    @property
+    def n_draining(self) -> int:
+        return sum(1 for r in self.replicas.values()
+                   if r.state == "draining")
+
+    def start(self) -> "ServeDriver":
+        """Open a dynamic serving session with ``cfg.n_replicas``
+        replicas (each through `add_replica` — the scale-up path is the
+        boot path). Requests then arrive via `submit()` and the caller
+        drives `tick()`; `stop()` drains and writes serving.json."""
+        if self.cfg.backend != "inline":
+            raise ValueError(
+                "dynamic serving sessions are inline-only today: "
+                "process replicas lack a driver->worker request "
+                "channel, so replica count is fixed for a process "
+                "run() (docs/AUTOSCALE.md 'limits')")
+        if self._session_active:
+            raise RuntimeError("session already started")
+        from ray_lightning_tpu.models.llama import Llama
+
+        if self.cfg.compile_cache_dir:
+            from ray_lightning_tpu.pipeline.compile_cache import (
+                enable_persistent_cache,
+            )
+
+            enable_persistent_cache(self.cfg.compile_cache_dir)
+        self._model = Llama(self.model_cfg)
+        self._session_active = True
+        self.replicas = {}
+        self._next_replica = 0
+        self._rr = 0
+        self.pending = deque()
+        self.outputs = {}
+        self.meta = {}
+        self.last_deferral = None
+        self.last_spawn_s = None
+        self._session_t0 = time.perf_counter()
+        self._session_tokens = 0
+        self._session_ticks = 0
+        mc = self._metrics_cfg()
+        if self.cfg.run_dir is not None and mc["enabled"]:
+            from ray_lightning_tpu.telemetry.metrics import (
+                FlightRecorder, MetricsRegistry,
+            )
+
+            tdir = os.path.join(self.cfg.run_dir, "telemetry")
+            self.driver_metrics = MetricsRegistry(
+                tdir, replica=0, prefix="driver",
+                flush_every_n_ticks=mc["flush_every"])
+            self.driver_flight = FlightRecorder(
+                os.path.join(tdir, "driver.flight.json"), replica=-1,
+                maxlen=mc["flight_ring"],
+                persist_every=mc["flight_persist_every"])
+        else:
+            from ray_lightning_tpu.telemetry.metrics import (
+                NULL_FLIGHT, NULL_METRICS,
+            )
+
+            self.driver_metrics = NULL_METRICS
+            self.driver_flight = NULL_FLIGHT
+        for _ in range(self.cfg.n_replicas):
+            self.add_replica()
+        return self
+
+    def inject_spawn_faults(self, count: int = 1,
+                            signal_name: str = "SIGKILL") -> None:
+        """Test/drill seam: the next ``count`` `add_replica` calls die
+        with a real `runtime.WorkerError` carrying ``signal_name``
+        death metadata — byte-for-byte what a worker SIGKILLed during
+        spawn/warmup surfaces, so the controller's
+        classify-retry-within-budget path is exercised without needing
+        a process backend (the autoscale --smoke drill)."""
+        self._spawn_faults.extend(
+            {"signal_name": signal_name} for _ in range(count))
+
+    def add_replica(self) -> int:
+        """Spawn one replica NOW — exactly the respawn path: params
+        reload from the .npz (when serving from a file), the step
+        compiled or DESERIALIZED through the persistent compile cache
+        (`pipeline.compile_cache`, armed at `start()`), then the
+        replica is live and routable. Returns the replica id. Raises
+        whatever the spawn raised (a `WorkerError` for worker-shaped
+        deaths) — the controller classifies it via `resilience.policy`
+        and retries within its budget."""
+        self._require_session()
+        r = self._next_replica
+        if self._spawn_faults:
+            fault = self._spawn_faults.pop(0)
+            from ray_lightning_tpu.runtime.group import WorkerError
+
+            self.driver_flight.record("spawn_fault", replica=r,
+                                      **fault)
+            raise WorkerError(
+                r, "injected spawn fault: replica worker killed "
+                   "during warmup (autoscale drill)",
+                signal_name=fault["signal_name"], cause="signal")
+        t0 = time.perf_counter()
+        params = (load_params_npz(self.params_path)
+                  if self.params_path is not None else self.params)
+        mc = self._metrics_cfg()
+        metrics = _make_metrics(self.cfg.run_dir, r,
+                                enabled=mc["enabled"],
+                                flush_every=mc["flush_every"])
+        flight = _make_flight(self.cfg.run_dir, r,
+                              enabled=mc["enabled"],
+                              maxlen=mc["flight_ring"],
+                              persist_every=mc["flight_persist_every"])
+        engine = DecodeEngine(self._model, params, self.cfg.engine,
+                              metrics=metrics)
+        engine.warmup()
+        sched = Scheduler(engine, reserve=self.cfg.reserve,
+                          metrics=metrics, flight=flight)
+        recorder = _make_recorder(self.cfg.run_dir, r)
+        warm_s = time.perf_counter() - t0
+        self._next_replica += 1
+        self.replicas[r] = _Replica(r, engine, sched, recorder, warm_s)
+        self.last_spawn_s = warm_s
+        self.driver_metrics.count("replicas_spawned")
+        self.driver_flight.record("spawn", replica=r,
+                                  warm_s=round(warm_s, 4),
+                                  live=self.n_live)
+        # give already-queued backlog to the new replica: queued work
+        # has no partial state, so redistribution is bitwise-neutral
+        # (per-request seeds make every stream placement-independent)
+        self._rebalance()
+        return r
+
+    def remove_replica(self, replica: Optional[int] = None,
+                       graceful: bool = True) -> int:
+        """Retire one replica. ``graceful`` (the default): stop
+        admissions to the victim, requeue its still-queued/preempted
+        work onto survivors (the bitwise replay seam — nothing partial
+        exists for queued work), and let its decoding slots drain to
+        retirement over subsequent `tick()`s before the worker stops.
+        ``graceful=False``: additionally evict the slotted requests for
+        replay elsewhere (partial streams dropped exactly like
+        replica-death replay) and stop immediately. Returns the victim
+        id (default: the newest live replica)."""
+        self._require_session()
+        if replica is None:
+            live = self.live_ids
+            if not live:
+                raise RuntimeError("no live replica to remove")
+            replica = live[-1]
+        rep = self.replicas.get(replica)
+        if rep is None or rep.state != "live":
+            raise ValueError(
+                f"replica {replica} is "
+                f"{'unknown' if rep is None else rep.state} — only a "
+                "live replica can be removed")
+        rep.state = "draining"
+        rep.sched.begin_drain()
+        self.driver_metrics.count("replicas_drain_begun")
+        self.driver_flight.record(
+            "drain_begin", replica=replica, graceful=graceful,
+            queued=len(rep.sched.queue), slotted=len(rep.sched.slots))
+        self._requeue_from(rep)
+        if not graceful:
+            # account the partial wall first (inflight-tagged spans),
+            # THEN evict: the replayed streams regenerate bitwise from
+            # their seeds on whichever survivor admits them
+            _record_drain(rep.recorder, rep.sched, replica)
+            for req, preempts in rep.sched.evict_slotted():
+                self.outputs[req.rid] = []
+                self._route(req, preempts)
+            self._stop_replica(rep)
+        return replica
+
+    def submit(self, req: Request) -> Optional[int]:
+        """Route one request to a live replica (round-robin). When
+        EVERY replica is draining or dead the request defers with a
+        structured reason (`last_deferral`, the driver metrics
+        ``submit_deferrals`` counter, a flight event) instead of
+        round-robining onto a stopping replica — deferred requests
+        re-route at the next `tick()` that finds a live replica.
+        Returns the replica id, or None when deferred."""
+        self._require_session()
+        from ray_lightning_tpu.serve.scheduler import validate_request
+
+        # validate BEFORE routing/deferring: the deferral path never
+        # reaches Scheduler.submit, and an unsatisfiable span enqueued
+        # raw would head-of-line-block its replica forever (it can
+        # never admit) — refuse it here like the fixed-batch path does
+        validate_request(self.cfg.engine, self.cfg.engine.pool_spec,
+                         req)
+        req = dataclasses.replace(req)
+        if req.arrival == 0.0:
+            req.arrival = time.perf_counter()
+        self.outputs.setdefault(req.rid, [])
+        return self._route(req, 0)
+
+    def tick(self) -> List[Completion]:
+        """One serving tick across the replica set: flush deferred
+        requests to any live replica, evict draining replicas' queues
+        onto survivors, tick every non-stopped replica, retire drains
+        that completed. Idle live replicas still tick (their gauges
+        keep the load signal honest about spare capacity)."""
+        self._require_session()
+        self._route_pending()
+        done: List[Completion] = []
+        for r in sorted(self.replicas):
+            rep = self.replicas[r]
+            if rep.state == "stopped":
+                continue
+            if rep.state == "draining":
+                # growth-stall preemptions land back in its queue;
+                # admissions are closed there, so move them out
+                self._requeue_from(rep)
+                if not rep.sched.slots and not rep.sched.queue:
+                    self._stop_replica(rep)
+                    continue
+            completions = rep.sched.tick()
+            for detail in rep.sched.last_preemption_details:
+                self.outputs[detail["rid"]] = []
+                _record_preemption(rep.recorder, detail, r)
+            if rep.state == "draining":
+                # a preemption during the drain tick: reroute now so
+                # the request is not parked behind closed admissions
+                self._requeue_from(rep)
+            for rid, tok in rep.sched.last_emissions:
+                self.outputs[rid].append(tok)
+                self._session_tokens += 1
+            for comp in completions:
+                _record_completion(rep.recorder, comp, r)
+                self.meta[comp.rid] = {
+                    "replica": r,
+                    "finish_reason": comp.finish_reason,
+                    "queue_wait_s": comp.queue_wait_s,
+                    "ttft_s": comp.ttft_s, "tpot_s": comp.tpot_s,
+                    "preempted": comp.preempted,
+                    "n_tokens": len(comp.tokens),
+                }
+                if len(rep.sched.completions) % \
+                        FLUSH_EVERY_N_COMPLETIONS == 0:
+                    rep.recorder.flush()
+            done.extend(completions)
+        self._session_ticks += 1
+        dm = self.driver_metrics
+        if dm.enabled:
+            dm.gauge("replicas_live", self.n_live)
+            dm.gauge("replicas_draining", self.n_draining)
+            dm.gauge("pending_requests", len(self.pending))
+            dm.tick_end()
+        return done
+
+    def busy(self) -> bool:
+        self._require_session()
+        return bool(self.pending) or any(
+            rep.sched.busy() for rep in self.replicas.values()
+            if rep.state != "stopped")
+
+    def stop(self, drain: bool = True) -> ServeResult:
+        """End the session. ``drain`` ticks until every stream
+        completes first; ``drain=False`` accounts in-flight work as
+        inflight-tagged spans and stops cold. Writes serving.json and
+        returns the session's ServeResult."""
+        self._require_session()
+        if drain:
+            while self.busy():
+                # work can defer INTO pending mid-drain (a draining
+                # replica's growth-stall preemption with no live
+                # survivor): once pending is the ONLY work left and no
+                # replica can ever take it, ticking forever would hang
+                # here — refuse loudly instead (review finding,
+                # test-pinned)
+                others_busy = any(
+                    rep.sched.busy() for rep in self.replicas.values()
+                    if rep.state != "stopped")
+                if self.pending and self.n_live == 0 and not others_busy:
+                    raise RuntimeError(
+                        f"{len(self.pending)} deferred request(s) with "
+                        "no live replica — add_replica() before "
+                        "stop(), or stop(drain=False) to abandon them")
+                self.tick()
+        final_replicas = self.n_live
+        for rep in self.replicas.values():
+            if rep.state == "stopped":
+                continue
+            _record_drain(rep.recorder, rep.sched, rep.id)
+            self._stop_replica(rep)
+        wall = time.perf_counter() - self._session_t0
+        occ = [rep.sched.slot_occupancy
+               for rep in self.replicas.values()]
+        stats = {
+            "decode_tokens_per_s":
+                self._session_tokens / max(wall, 1e-9),
+            "slot_occupancy": float(np.mean(occ)) if occ else None,
+            "n_requests": len(self.outputs),
+            "n_tokens": self._session_tokens,
+            "wall_s": wall,
+            "ticks": self._session_ticks,
+            "compile_count": max(
+                (rep.engine.compile_count
+                 for rep in self.replicas.values()), default=None),
+            "replicas_spawned": self._next_replica,
+            "final_replicas": final_replicas,
+            "submit_deferrals":
+                self.driver_metrics.counters().get(
+                    "submit_deferrals", 0),
+            "last_spawn_s": self.last_spawn_s,
+        }
+        result = ServeResult(
+            outputs=self.outputs, meta=self.meta,
+            restarts={r: 0 for r in self.replicas}, stats=stats)
+        self.driver_metrics.close()
+        self.driver_flight.close()
+        self._write_summary(result)
+        self._session_active = False
+        return result
+
+    # ---- session internals ----------------------------------------------
+
+    def _pick_replica(self) -> Optional[int]:
+        live = self.live_ids
+        if not live:
+            return None
+        target = live[self._rr % len(live)]
+        self._rr += 1
+        return target
+
+    def _route(self, req: Request, preempts: int) -> Optional[int]:
+        target = self._pick_replica()
+        if target is None:
+            self.pending.append((req, preempts))
+            self.last_deferral = {
+                "rid": req.rid,
+                "reason": "no live replica: all replicas draining "
+                          "or dead",
+                "draining": self.n_draining,
+                "pending": len(self.pending),
+                "at": time.perf_counter(),
+            }
+            self.driver_metrics.count("submit_deferrals")
+            self.driver_flight.record("submit_deferral", rid=req.rid,
+                                      draining=self.n_draining,
+                                      pending=len(self.pending))
+            return None
+        self.replicas[target].sched.enqueue(req, preempts)
+        return target
+
+    def _route_pending(self) -> None:
+        while self.pending and self.live_ids:
+            req, preempts = self.pending.popleft()
+            self._route(req, preempts)
+
+    def _requeue_from(self, rep: "_Replica") -> None:
+        for req, preempts in rep.sched.evict_queued():
+            self._route(req, preempts)
+
+    def _rebalance(self) -> None:
+        """Even out queued (never-admitted) backlog across live
+        replicas after a scale-up: without this, work enqueued before
+        the spawn would keep draining through the old replica alone.
+        Deterministic (FIFO by arrival) and bitwise-neutral (queued
+        work has no partial state; streams are seed-pure)."""
+        live = [self.replicas[r] for r in self.live_ids]
+        if len(live) < 2:
+            return
+        backlog: List = []
+        for rep in live:
+            backlog.extend(rep.sched.evict_queued())
+        if not backlog:
+            return
+        backlog.sort(key=lambda item: item[0].arrival)
+        for i, (req, preempts) in enumerate(backlog):
+            live[i % len(live)].sched.enqueue(req, preempts)
+
+    def _stop_replica(self, rep: "_Replica") -> None:
+        rep.state = "stopped"
+        rep.recorder.flush()
+        rep.recorder.close()
+        m = rep.sched.metrics
+        if m.enabled:
+            # stamp the stream retired so the load signal stops
+            # pooling this replica's stale window into LIVE pressure
+            # (telemetry/metrics.py load_signal_from_parsed)
+            m.gauge("retired", 1)
+            m.tick_end()
+        m.close()
+        rep.sched.flight.record("drain_end", replica=rep.id)
+        rep.sched.flight.close()
+        self.driver_metrics.count("replicas_stopped")
+        self.driver_flight.record("drain_end", replica=rep.id,
+                                  live=self.n_live)
 
     def _write_summary(self, result: ServeResult) -> None:
         if self.cfg.run_dir is None:

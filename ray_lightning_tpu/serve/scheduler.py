@@ -102,6 +102,36 @@ class _PrefillGroup:
     next: int = 0
 
 
+def validate_request(cfg, spec, req: Request) -> None:
+    """The admission-time span checks EVERY submission path must pass
+    — `Scheduler.submit` and the driver's dynamic-session `submit()`
+    (which may have to defer a request before any scheduler sees it;
+    an unvalidated oversize request would sit at a FIFO head forever,
+    head-of-line-blocking the replica — review finding, test-pinned).
+    ``cfg`` is the `EngineConfig`, ``spec`` its pool spec."""
+    total = req.prompt.size + req.max_new_tokens
+    padded = ""
+    if cfg.prefill_batch > 1:
+        # batched prefill right-aligns the prompt to a chunk multiple
+        # even when the request is admitted alone — the admission-time
+        # span must cover that pad
+        ch = cfg.prefill_chunk
+        total = -(-req.prompt.size // ch) * ch + req.max_new_tokens
+        padded = " (chunk-padded)"
+    if total > cfg.max_slot_len:
+        raise ValueError(
+            f"request {req.rid}: prompt {req.prompt.size}{padded} + "
+            f"max_new_tokens {req.max_new_tokens} exceeds the "
+            f"engine's max_slot_len {cfg.max_slot_len}")
+    if -(-total // spec.block_size) > spec.n_blocks - 1:
+        # even with the pool to itself this request cannot finish —
+        # admitting it would preempt-loop forever in on_demand mode
+        raise ValueError(
+            f"request {req.rid}: span {total} needs more blocks "
+            f"than the whole pool holds "
+            f"({spec.n_blocks - 1} usable)")
+
+
 def _key_data(seed: int) -> np.ndarray:
     return np.array(jax.random.key_data(jax.random.key(seed)),
                     np.uint32)
@@ -174,37 +204,80 @@ class Scheduler:
         #: running occupancy: decoding-slot fraction summed over ticks
         self._occupancy_sum = 0.0
         self._ticks = 0
+        #: drain mode (autoscale scale-down, docs/AUTOSCALE.md):
+        #: admissions stop, already-slotted work decodes to retirement,
+        #: and the driver evicts whatever lands back in the queue
+        self.draining = False
 
     # ---- submission ------------------------------------------------------
 
     def submit(self, req: Request) -> None:
-        total = req.prompt.size + req.max_new_tokens
-        padded = ""
-        if self.cfg.prefill_batch > 1:
-            # batched prefill right-aligns the prompt to a chunk
-            # multiple even when the request is admitted alone — the
-            # admission-time span must cover that pad
-            ch = self.cfg.prefill_chunk
-            total = -(-req.prompt.size // ch) * ch + req.max_new_tokens
-            padded = " (chunk-padded)"
-        if total > self.cfg.max_slot_len:
-            raise ValueError(
-                f"request {req.rid}: prompt {req.prompt.size}{padded} + "
-                f"max_new_tokens {req.max_new_tokens} exceeds the "
-                f"engine's max_slot_len {self.cfg.max_slot_len}")
-        if -(-total // self.spec.block_size) > self.spec.n_blocks - 1:
-            # even with the pool to itself this request cannot finish —
-            # admitting it would preempt-loop forever in on_demand mode
-            raise ValueError(
-                f"request {req.rid}: span {total} needs more blocks "
-                f"than the whole pool holds "
-                f"({self.spec.n_blocks - 1} usable)")
+        validate_request(self.cfg, self.spec, req)
         if req.arrival == 0.0:
             req.arrival = time.perf_counter()
-        self.queue.append((req, 0))
+        self.enqueue(req, 0)
+
+    def enqueue(self, req: Request, preempts: int) -> None:
+        """Queue a validated request carrying its prior preemption
+        count — the requeue path a scale-down/eviction uses so a
+        request bounced between replicas keeps honest `preempted`
+        accounting. External submissions go through `submit()` (which
+        validates the span against THIS engine's pool first)."""
+        if self.draining:
+            raise RuntimeError(
+                f"scheduler is draining — request {req.rid} must route "
+                "to a live replica (driver bug: admissions are closed "
+                "here)")
+        self.queue.append((req, preempts))
 
     def busy(self) -> bool:
         return bool(self.queue or self.slots)
+
+    # ---- drain / eviction (the scale-down seams, docs/AUTOSCALE.md) ------
+
+    def begin_drain(self) -> None:
+        """Stop admissions for good: queued work must be evicted onto
+        survivors (`evict_queued`), slotted work decodes to retirement
+        under further `tick()`s. Idempotent."""
+        if not self.draining:
+            self.draining = True
+            self.flight.record("drain_begin", queued=len(self.queue),
+                               slotted=len(self.slots))
+
+    def evict_queued(self) -> List[Tuple[Request, int]]:
+        """Pop every still-queued (never admitted, or preempted-back)
+        request for requeue on another replica. No partial state exists
+        for these — replay elsewhere is bitwise by construction (same
+        seed, same stream)."""
+        out = list(self.queue)
+        self.queue.clear()
+        for req, preempts in out:
+            self.flight.record("evict", rid=req.rid, state="queued",
+                               preempted=preempts)
+        return out
+
+    def evict_slotted(self) -> List[Tuple[Request, int]]:
+        """Forced (non-graceful) drain: tear every slot down, free its
+        blocks, and return the requests with their preemption count
+        bumped — the existing bitwise replay seam: a consumer discards
+        the partial stream and the re-decode regenerates it identically
+        from the seed (exactly what replica-death replay does)."""
+        out: List[Tuple[Request, int]] = []
+        for s in sorted(self.slots):
+            slot = self.slots.pop(s)
+            self.alloc.free(slot.blocks)
+            self.tables[s, :] = 0
+            self.decoding[s] = False
+            self.pos[s] = 0
+            self.pad[s] = 0
+            self.free_slots.append(s)
+            self.flight.record("evict", rid=slot.req.rid,
+                               state="slotted",
+                               emitted=len(slot.emitted),
+                               preempted=slot.preempted + 1)
+            out.append((slot.req, slot.preempted + 1))
+        self.prefill_groups.clear()
+        return out
 
     # ---- internals -------------------------------------------------------
 
@@ -258,6 +331,11 @@ class Scheduler:
         return s
 
     def _admit(self) -> None:
+        if self.draining:
+            # admissions are closed: anything in the queue (including a
+            # request a growth stall just preempted back) waits for the
+            # driver's eviction pass, never re-admits here
+            return
         if self.cfg.prefill_batch == 1:
             while self.queue and self.free_slots:
                 s = self._admit_one(self.queue[0][0].prompt.size)

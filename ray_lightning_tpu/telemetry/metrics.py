@@ -239,9 +239,15 @@ class MetricsRegistry:
     enabled = True
 
     def __init__(self, directory: Optional[str] = None, replica: int = 0,
-                 ring_size: int = 2048, flush_every_n_ticks: int = 32):
+                 ring_size: int = 2048, flush_every_n_ticks: int = 32,
+                 prefix: str = "replica"):
         self.directory = directory
         self.replica = replica
+        #: file-name prefix: "replica" streams feed the load signal /
+        #: aggregation globs; other prefixes ("driver" — the autoscale
+        #: session's scale/deferral counters) are read by their own
+        #: consumers and deliberately stay OUT of the replica rollups
+        self.prefix = prefix
         self.flush_every_n_ticks = max(1, flush_every_n_ticks)
         self._lock = threading.Lock()
         self._counters: Dict[str, int] = {}
@@ -258,7 +264,7 @@ class MetricsRegistry:
         if directory is not None:
             os.makedirs(directory, exist_ok=True)
             self._path = os.path.join(
-                directory, f"replica{replica}.{self.uid}.metrics.jsonl")
+                directory, f"{prefix}{replica}.{self.uid}.metrics.jsonl")
             with open(self._path, "w") as f:
                 f.write(json.dumps({
                     "version": METRICS_VERSION, "replica": replica,
@@ -457,6 +463,16 @@ def metrics_paths(directory: str) -> List[str]:
         os.path.join(directory, "replica*.metrics.jsonl")))
 
 
+def driver_metrics_paths(directory: str) -> List[str]:
+    """The autoscale session's driver-level metrics stream(s)
+    (prefix="driver": scale events, submit deferrals, live-replica
+    gauges) — kept out of the replica rollups above by file name."""
+    import glob as _glob
+
+    return sorted(_glob.glob(
+        os.path.join(directory, "driver*.metrics.jsonl")))
+
+
 # ---- cross-file aggregation (report / monitor / the load signal) ----------
 
 
@@ -618,10 +634,19 @@ def load_signal_from_parsed(newest_per_replica: Dict[str, dict],
     total_slots = 0.0
     blocks_free_fraction: Optional[float] = None
     per_replica: Dict[str, dict] = {}
+    retired: List[str] = []
     for rep, entry in sorted(newest_per_replica.items()):
         parsed = entry["parsed"]
-        recent = parsed["ticks"][-window:]
         g_last = parsed["gauges"]
+        if g_last.get("retired"):
+            # a scale-down stamped this replica retired at drain
+            # completion (serve/driver.py): its file stays on disk but
+            # its stale window must not dilute the LIVE pressure — a
+            # retired replica's trailing zeros would halve the pooled
+            # p50 and talk the controller out of a needed scale-up
+            retired.append(rep)
+            continue
+        recent = parsed["ticks"][-window:]
         qd = [float((s.get("g") or {}).get("queue_depth", 0.0))
               for s in recent]
         occ = [float((s.get("g") or {}).get("slot_occupancy", 0.0))
@@ -642,6 +667,11 @@ def load_signal_from_parsed(newest_per_replica: Dict[str, dict],
             "occupancy": (sum(occ) / len(occ)) if occ else None,
             "ticks": len(parsed["ticks"]),
         }
+    if not per_replica:
+        return {"available": False,
+                "reason": "every replica reporting under "
+                          f"{where} is retired (scaled away)",
+                "replicas_retired": len(retired)}
     qd_sorted = sorted(qd_window) or [0.0]
     qd_p50 = qd_sorted[len(qd_sorted) // 2]
     signal: Dict[str, Any] = {
@@ -657,6 +687,8 @@ def load_signal_from_parsed(newest_per_replica: Dict[str, dict],
         "window_ticks": len(qd_window),
         "replicas": per_replica,
     }
+    if retired:
+        signal["replicas_retired"] = len(retired)
     if blocks_free_fraction is not None:
         signal["blocks_free_fraction"] = blocks_free_fraction
     return signal

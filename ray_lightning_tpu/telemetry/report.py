@@ -365,6 +365,72 @@ def build_serving_section(run_dir: str) -> Optional[Dict[str, Any]]:
                 (metrics_agg.get("replicas") or {}).items())}
         section["load_signal"] = load_signal_from_parsed(
             newest_from_parsed(parsed_metrics), where=tdir)
+    autoscale = build_autoscale_section(base, tdir)
+    if autoscale:
+        section["autoscale"] = autoscale
+    return section
+
+
+def build_autoscale_section(base: str,
+                            tdir: str) -> Optional[Dict[str, Any]]:
+    """The controller's decision ledger, summarized
+    (``<run_dir>/autoscale.jsonl``, docs/AUTOSCALE.md): decision/event
+    counts, spawn retries, the final replica count, the last decision
+    with its reason, plus the driver-stream scale/deferral counters
+    (``driver*.metrics.jsonl``). None when the run never ran a
+    controller — plain serving reports stay unchanged."""
+    from ray_lightning_tpu.autoscale.controller import read_ledger
+    from ray_lightning_tpu.telemetry.metrics import (
+        driver_metrics_paths, read_metrics,
+    )
+
+    entries = read_ledger(base)
+    if not entries:
+        return None
+
+    def _acted(e: dict) -> bool:
+        # an event is anything that CHANGED the replica set — a partial
+        # scale-up (outcome.ok False but replicas added before the
+        # budget ran out) must still show in the timeline, or the
+        # report would contradict final_replicas (review finding)
+        out = e.get("outcome") or {}
+        return bool(out.get("added") or out.get("removed"))
+
+    events = [e for e in entries if _acted(e)]
+    last = entries[-1]
+    section: Dict[str, Any] = {
+        "decisions": len(entries),
+        "scale_ups": sum(1 for e in events
+                         if e["decision"]["action"] == "scale_up"),
+        "scale_downs": sum(1 for e in events
+                           if e["decision"]["action"] == "scale_down"),
+        "spawn_retries": sum(
+            int((e.get("outcome") or {}).get("retries") or 0)
+            for e in entries),
+        "final_replicas": last.get("replicas"),
+        "last_decision": {
+            "now": last.get("now"),
+            **(last.get("decision") or {}),
+        },
+        "events": [{"now": e.get("now"),
+                    "action": e["decision"]["action"],
+                    "target": e["decision"]["target"],
+                    **({} if (e.get("outcome") or {}).get("ok")
+                       else {"partial": True})}
+                   for e in events],
+    }
+    counters: Dict[str, int] = {}
+    for path in driver_metrics_paths(tdir):
+        try:
+            parsed = read_metrics(path)
+        except OSError:
+            continue
+        for name, v in parsed["counters"].items():
+            counters[name] = counters.get(name, 0) + int(v)
+    if counters:
+        section["driver_counters"] = counters
+        if "submit_deferrals" in counters:
+            section["submit_deferrals"] = counters["submit_deferrals"]
     return section
 
 
@@ -460,6 +526,22 @@ def _print_report(out: Dict[str, Any]) -> None:
                   f"{sig['queue_depth_p50']:.0f}, occupancy "
                   f"{sig['occupancy']:.2f}, pressure "
                   f"{sig['pressure'] if sig['pressure'] is not None else '—'}")
+        asc = sv.get("autoscale")
+        if asc:
+            print(f"  autoscale: {asc['decisions']} decision(s) -> "
+                  f"{asc['scale_ups']} up / {asc['scale_downs']} down"
+                  f" ({asc['spawn_retries']} spawn retr{'y' if asc['spawn_retries'] == 1 else 'ies'}), "
+                  f"final replicas {asc['final_replicas']}")
+            for e in asc.get("events") or []:
+                print(f"    t={e['now']:g}: {e['action']} -> "
+                      f"{e['target']}")
+            ld = asc.get("last_decision") or {}
+            if ld.get("reason"):
+                print(f"    last: {ld.get('action')} — "
+                      f"{ld['reason']}")
+            if asc.get("submit_deferrals"):
+                print(f"    submit deferrals: "
+                      f"{asc['submit_deferrals']}")
     ss = out.get("step_stats")
     if ss:
         print(f"warm step time: mean {ss['mean_s'] * 1e3:.2f} ms / "
@@ -609,6 +691,10 @@ def _monitor_serve_once(run_dir: str) -> Dict[str, Any]:
             "compile_count": g.get("compile_count"),
         }
     view["load_signal"] = load_signal_from_parsed(newest, where=tdir)
+    base = run_dir if tdir != run_dir else os.path.dirname(run_dir)
+    asc = build_autoscale_section(base, tdir)
+    if asc:
+        view["autoscale"] = asc
     return view
 
 
@@ -634,6 +720,14 @@ def _print_serve_view(view: Dict[str, Any]) -> None:
               f"{sig['occupancy']:.2f}"
               + (f", pressure {pressure:.2f}"
                  if pressure is not None else ""))
+    asc = view.get("autoscale")
+    if asc:
+        ld = asc.get("last_decision") or {}
+        print(f"  autoscale: replicas {asc['final_replicas']}, "
+              f"{asc['decisions']} decision(s) "
+              f"({asc['scale_ups']} up / {asc['scale_downs']} down); "
+              f"last: {ld.get('action')} — "
+              f"{(ld.get('reason') or '')[:70]}")
 
 
 def run_monitor(args) -> int:

@@ -27,6 +27,9 @@ Bounded metrics (upper limits, not ratchets):
                                  overrides, skip/null waives)
     reshard_restore_s            elastic cross-topology restore wall
                                  (ISSUE 9)
+    scale_up_s                   autoscale add_replica actuation wall
+                                 (ISSUE 13; RLT_BENCH_SCALE_UP_MAX
+                                 overrides, skip/null waives)
 
 Gate semantics:
 
@@ -135,6 +138,14 @@ BOUNDED = {
     # (or the storage layer regressed) — the elastic story's hot path.
     "reshard_restore_s": float(
         os.environ.get("RLT_BENCH_RESHARD_MAX", 30.0)),
+    # autoscale actuation (serving leg, ISSUE 13): the wall one
+    # controller-driven add_replica pays — spawn + weight reload +
+    # step compile/deserialize + warmup. This is how long a pressure
+    # spike waits before capacity actually arrives; growth means the
+    # respawn path regressed (e.g. the persistent compile cache
+    # stopped hitting). Skip/null waived like every bound.
+    "scale_up_s": float(
+        os.environ.get("RLT_BENCH_SCALE_UP_MAX", 120.0)),
 }
 
 
@@ -312,6 +323,10 @@ def gate(fresh: dict, best: dict, tolerance: float,
                     "the steady-state TTFT tail blew its SLO bound — "
                     "queueing/prefill latency grew on the serving hot "
                     "path (see the histogram sketch in `report`)",
+                "scale_up_s":
+                    "autoscale actuation slowed — a pressure spike now "
+                    "waits this long for capacity (the respawn path "
+                    "or its compile-cache re-warm regressed)",
             }
             what = whats.get(
                 key, "the serving warm path regressed (recompile "

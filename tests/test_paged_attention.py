@@ -500,7 +500,7 @@ def test_bench_serve_summary_carries_hbm_metric():
 def test_measured_serving_records_attention_path():
     import bench
 
-    got = bench._measure_serving(tiny=True)
+    got = bench._measure_serving(tiny=True, autoscale=False)
     assert got["serving_attention_path"] in ("paged-pallas",
                                              "reference-gather")
     assert got["decode_tokens_per_s"] > 0

@@ -382,7 +382,7 @@ def test_plan_serve_does_not_fit_exit_1(capsys):
 def test_bench_serving_leg_schema():
     import bench
 
-    r = bench._measure_serving(tiny=True)
+    r = bench._measure_serving(tiny=True, autoscale=False)
     for key in ("decode_tokens_per_s", "ttft_cold_s", "ttft_warm_s",
                 "slot_occupancy"):
         assert key in r, key
@@ -401,7 +401,7 @@ def test_bench_serve_summary_static():
     assert set(s["serving"]["schema"]) == {
         "decode_tokens_per_s", "ttft_cold_s", "ttft_warm_s",
         "ttft_p99_s", "slot_occupancy", "serving_attention_path",
-        "serve_metrics"}
+        "serve_metrics", "scale_up_s", "autoscale"}
 
 
 def test_bench_gate_ratchets_serving(tmp_path):

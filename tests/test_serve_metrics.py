@@ -597,7 +597,7 @@ def test_bench_serving_leg_schema():
         os.path.abspath(__file__))))
     import bench
 
-    row = bench._measure_serving(tiny=True)
+    row = bench._measure_serving(tiny=True, autoscale=False)
     assert row["ttft_p99_s"] is not None
     sm = row["serve_metrics"]
     for key in ("queue_depth_p50", "queue_depth_max", "preemptions",
