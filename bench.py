@@ -667,7 +667,20 @@ def _measure_autoscale(cfg, ecfg, params) -> dict:
             ctl.step(now=0.0)     # scale up: the measured spawn
             ctl.step(now=100.0)   # scale down: graceful drain
             result = drv.stop()
+            # SLO watch over the drill's own run dir (telemetry/
+            # watch.py, ISSUE 14): evaluate the built-in rules against
+            # the evidence the drill just persisted. A healthy bench
+            # fires ZERO incidents — bench_gate fails the round on
+            # incidents > 0 (a breach in the bench's own serving drill
+            # is a regression, not noise); skip/null lines waive.
+            from ray_lightning_tpu.telemetry.watch import (
+                WatchConfig, WatchEngine,
+            )
+
+            watch = WatchEngine(as_dir, WatchConfig(capture=False))
+            watch.poll()
             return {
+                "incidents": len(watch.incidents),
                 "scale_up_s": (round(ctl.scale_up_s[0], 4)
                                if ctl.scale_up_s else None),
                 "autoscale": {
@@ -685,6 +698,29 @@ def _measure_autoscale(cfg, ecfg, params) -> dict:
     except Exception as exc:  # noqa: BLE001 — advisory drill only
         return {"autoscale_error":
                 f"{type(exc).__name__}: {str(exc)[:200]}"}
+
+
+def _watch_summary() -> dict:
+    """Watch/incident SCHEMA for every JSON line this process emits
+    (ISSUE 14): the rule vocabulary and the shape the measured
+    ``incidents`` count (success lines only — the serving drill's run
+    dir is the subject) will take. Static, no backend touch: a
+    backend-down skip line still tells the recorder what the field
+    means, and bench_gate waives the absent count there."""
+    try:
+        from ray_lightning_tpu.telemetry.watch import BUILTIN_RULES
+
+        return {"watch": {
+            "schema": {
+                "incidents": "watch-rule breaches fired against the "
+                             "bench's own autoscale-drill run dir "
+                             "(success lines; absent/null waived)",
+            },
+            "rules": [r.name for r in BUILTIN_RULES],
+            "source": "static-schema",
+        }}
+    except Exception as exc:  # noqa: BLE001 — advisory data only
+        return {"watch_error": f"{type(exc).__name__}: {str(exc)[:200]}"}
 
 
 def _kill_line(signame: str) -> str:
@@ -907,6 +943,7 @@ def main() -> None:
     _ANALYSIS.update(_guard_summary())
     _ANALYSIS.update(_telemetry_summary())
     _ANALYSIS.update(_serve_summary())
+    _ANALYSIS.update(_watch_summary())
 
     # Watchdog: a wedged device tunnel (observed on shared-chip setups:
     # every op, even jax.devices(), blocks forever) must surface as an

@@ -89,6 +89,17 @@ JAX_PLATFORMS=cpu python -m ray_lightning_tpu supervise --smoke > /dev/null
 # composition.
 JAX_PLATFORMS=cpu python -m ray_lightning_tpu monitor --smoke > /dev/null
 
+# watch/incident gate (docs/OBSERVABILITY.md "watch rules &
+# incidents"): a scripted serving run with an INJECTED latency stall
+# must fire the built-in ttft_p99 rule EXACTLY ONCE (episode
+# semantics: a sustained breach is one incident, not one per poll),
+# land a parseable incident record carrying metric evidence (value +
+# histogram sketch) and a timeline excerpt of the surrounding events,
+# and trigger one profiler CAPTURE-marker evidence capture; and the
+# run dir's unified timeline must export valid Chrome-trace JSON with
+# events from >= 4 distinct source subsystems ordered by aligned time.
+JAX_PLATFORMS=cpu python -m ray_lightning_tpu watch --smoke > /dev/null
+
 # serving gate (docs/SERVING.md): 8 concurrent staggered streams
 # (ragged prompts, mixed greedy/temperature/top-k) through the
 # continuous-batching engine must decode bitwise-identical to 8

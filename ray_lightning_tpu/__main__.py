@@ -90,6 +90,29 @@ goodput report sums to wall, flagship drift section emits):
     python -m ray_lightning_tpu monitor rlt_logs --follow
     python -m ray_lightning_tpu monitor --smoke
 
+``timeline`` merges EVERY evidence ledger a run dir holds — spans,
+goodput attempts, serving metrics ticks, flight rings, autoscale
+decisions, reshards, incidents — into one causally-ordered stream
+(telemetry/timeline.py, docs/OBSERVABILITY.md "unified timeline");
+``--chrome`` exports Chrome-trace/Perfetto JSON so the whole run opens
+as one trace:
+
+    python -m ray_lightning_tpu timeline rlt_logs
+    python -m ray_lightning_tpu timeline rlt_logs --chrome trace.json
+
+``watch`` evaluates the declarative SLO rules (telemetry/watch.py:
+ttft_p99, goodput_fraction, queue pressure, guard streaks, restart
+rate) over a run dir's persisted evidence; a sustained breach appends
+a self-documenting record to incidents.jsonl (metric evidence + a
+timeline excerpt) and actuates the evidence hooks (profiler CAPTURE
+marker, forced flight persist). ``--smoke`` is the format.sh gate (an
+injected serving latency stall must fire the ttft rule exactly once
+and the run's timeline must export as a valid multi-source Chrome
+trace):
+
+    python -m ray_lightning_tpu watch rlt_logs --follow
+    python -m ray_lightning_tpu watch --smoke
+
 Exit status: 0 when the plan fits, 1 when it does not, 2 when the
 configuration is invalid (e.g. a global batch not divisible by the
 data-parallel degree — refused rather than planned wrong; the error goes
@@ -543,6 +566,12 @@ def main(argv=None) -> int:
     from ray_lightning_tpu.telemetry.report import (
         add_monitor_parser, add_report_parser, run_monitor, run_report,
     )
+    from ray_lightning_tpu.telemetry.timeline import (
+        add_timeline_parser, run_timeline,
+    )
+    from ray_lightning_tpu.telemetry.watch import (
+        add_watch_parser, run_watch,
+    )
 
     add_lint_parser(sub)
     add_trace_parser(sub)
@@ -551,6 +580,8 @@ def main(argv=None) -> int:
     add_serve_parser(sub)
     add_report_parser(sub)
     add_monitor_parser(sub)
+    add_timeline_parser(sub)
+    add_watch_parser(sub)
     add_elastic_parser(sub)
     add_autoscale_parser(sub)
     args = p.parse_args(argv)
@@ -570,6 +601,10 @@ def main(argv=None) -> int:
         return run_report(args)
     if args.cmd == "monitor":
         return run_monitor(args)
+    if args.cmd == "timeline":
+        return run_timeline(args)
+    if args.cmd == "watch":
+        return run_watch(args)
     if args.cmd == "elastic":
         return run_elastic(args)
     if args.cmd == "autoscale":

@@ -172,6 +172,16 @@ RULES: Dict[str, Rule] = {r.id: r for r in (
          "Keep device shapes fixed — decode into a position-indexed "
          "KV cache, pad prompts to buckets, or use the fixed-capacity "
          "slot engine (serve.DecodeEngine, docs/SERVING.md)"),
+    Rule("RLT503", "unbounded-ledger-read", "warning",
+         "a cadence-polled code path (a sleep-loop — monitor --follow, "
+         "a controller poll, watch evaluation) parses an ENTIRE *.jsonl "
+         "evidence ledger into memory every poll: the ledger grows for "
+         "the life of the run, so the poll cost grows without bound "
+         "and the live view eventually spends its whole interval "
+         "re-parsing history it already saw. Thread a tail/window "
+         "bound (read_spans/read_metrics tail_bytes=, load_signal "
+         "window=) — the readers keep the clock-alignment header and "
+         "the newest entries, which is all a live view needs"),
     # RLT6xx — elasticity anti-patterns (docs/ELASTIC.md): code that
     # pins a job to one world size for life.
     Rule("RLT601", "pinned-world-size", "warning",

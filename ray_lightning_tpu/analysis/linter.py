@@ -1354,6 +1354,167 @@ class _PinnedWorldLint:
             "— docs/ELASTIC.md", node)
 
 
+# ---- RLT503: unbounded ledger reads on cadence-polled paths ---------------
+
+#: terminal names of the evidence-ledger readers that parse a whole
+#: growing *.jsonl (or a directory of them) per call; every one accepts
+#: a tail/window bound its cadence-polled callers must thread
+#: (telemetry/spans.py ledger_tail_lines is the shared substrate)
+_LEDGER_READERS: Set[str] = {
+    "read_spans", "read_metrics", "read_all_metrics",
+    "newest_metrics_per_replica", "aggregate_metrics_dir",
+    "load_signal_from_dir", "load_signal", "read_ledger",
+    "read_ledgers", "read_incidents", "load_timeline",
+    "load_timeline_events",
+}
+
+#: kwargs whose presence (with anything but a literal None) marks the
+#: call bounded. A threaded VARIABLE counts — the caller owns the
+#: bound; the defect this rule hunts is the reader given no bound at
+#: all on a polled path.
+_LEDGER_BOUND_KWARGS: Set[str] = {
+    "tail_bytes", "max_bytes", "window", "limit", "last_n",
+}
+
+
+def _rlt503_bounded(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg in _LEDGER_BOUND_KWARGS:
+            if isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is None:
+                continue
+            return True
+    return False
+
+
+def _rlt503_loop_nodes(loop: ast.AST) -> Iterable[ast.AST]:
+    """One loop's body nodes, nested loops included, nested function
+    defs excluded (they run on their own schedule, not per poll)."""
+    stack: List[ast.AST] = list(loop.body) + list(
+        getattr(loop, "orelse", ()) or ())
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _rlt503_is_sleep(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = _dotted(node.func) or ""
+    return name.split(".")[-1] == "sleep"
+
+
+class _LedgerTailLint:
+    """RLT503 unbounded-ledger-read (docs/OBSERVABILITY.md): a
+    cadence-polled code path — a loop that sleeps between iterations
+    (`monitor --follow`, a controller poll loop), or any same-file
+    function reachable from one — parses an entire evidence ledger
+    into memory every poll. The appended-forever ledgers make that a
+    cost that grows with run age; every reader takes a tail/window
+    bound, and threading one (even as a variable) sanctions the call.
+    Reachability follows the same same-file call edges the traced-set
+    fixpoint uses, so a reader two helpers below the follow loop still
+    fires."""
+
+    def __init__(self, lint: _FileLint):
+        self.lint = lint
+        self._reported: Set[int] = set()
+
+    def _lint_call(self, node: ast.AST,
+                   symbol: Optional[str]) -> None:
+        if not isinstance(node, ast.Call) or id(node) in self._reported:
+            return
+        name = (_dotted(node.func) or "").split(".")[-1]
+        if name not in _LEDGER_READERS or _rlt503_bounded(node):
+            return
+        self._reported.add(id(node))
+        self.lint.add(
+            "RLT503",
+            f"{name}() parses its whole ledger on a cadence-polled "
+            "path with no tail/window bound: the *.jsonl evidence "
+            "ledgers are append-only and grow for the life of the "
+            "run, so every poll re-parses all of history and the "
+            "live view's cost grows without bound. Thread a bound "
+            "(tail_bytes= / window= — the readers keep the "
+            "clock-alignment header and the newest entries, which is "
+            "all a live view needs; docs/OBSERVABILITY.md "
+            "'unified timeline')", node, symbol)
+
+    def run(self, tree: ast.Module, coll: "_Collector") -> None:
+        polled: Set[int] = set()
+        fn_of_id = {id(fn.node): fn for fn in coll.funcs}
+
+        def _seed_from_loop(loop: ast.AST, cls: Optional[str],
+                            symbol: Optional[str]) -> None:
+            nodes = list(_rlt503_loop_nodes(loop))
+            if not any(_rlt503_is_sleep(n) for n in nodes):
+                return
+            for n in nodes:
+                self._lint_call(n, symbol)
+                if not isinstance(n, ast.Call):
+                    continue
+                if isinstance(n.func, ast.Name):
+                    for callee in coll.by_name.get(n.func.id, ()):
+                        if callee.cls is None and callee.parent is None:
+                            polled.add(id(callee.node))
+                elif (isinstance(n.func, ast.Attribute)
+                        and isinstance(n.func.value, ast.Name)
+                        and n.func.value.id == "self"
+                        and cls is not None):
+                    callee = coll.by_method.get((cls, n.func.attr))
+                    if callee is not None:
+                        polled.add(id(callee.node))
+
+        for fn in coll.funcs:
+            for node in _own_nodes(fn.node):
+                if isinstance(node, (ast.While, ast.For)):
+                    _seed_from_loop(node, fn.cls, fn.qualname)
+        # module-level polling scripts
+        stack: List[ast.AST] = list(tree.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, (ast.While, ast.For)):
+                _seed_from_loop(node, None, None)
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+        # propagate polled-ness along same-file call edges (the traced
+        # fixpoint's resolution rules)
+        changed = True
+        while changed:
+            changed = False
+            for fn in coll.funcs:
+                if id(fn.node) not in polled:
+                    continue
+                for kind, name in fn.calls:
+                    if kind == "self" and fn.cls is not None:
+                        callee = coll.by_method.get((fn.cls, name))
+                        if callee is not None and \
+                                id(callee.node) not in polled:
+                            polled.add(id(callee.node))
+                            changed = True
+                    elif kind == "name":
+                        for callee in coll.by_name.get(name, ()):
+                            if callee.cls is None \
+                                    and callee.parent is None \
+                                    and id(callee.node) not in polled:
+                                polled.add(id(callee.node))
+                                changed = True
+        for node_id in polled:
+            fn = fn_of_id.get(node_id)
+            if fn is None:
+                continue
+            for node in _own_nodes(fn.node):
+                self._lint_call(node, fn.qualname)
+
+
 def lint_source(source: str, filename: str = "<string>",
                 extra_axes: Sequence[str] = ()) -> List[Finding]:
     """Lint one file's source text. Never imports the target."""
@@ -1414,6 +1575,7 @@ def lint_source(source: str, filename: str = "<string>",
     _TelemetryCallbackLint(lint).run(tree)
     _ServeLoopLint(lint).run(tree, coll.funcs)
     _PinnedWorldLint(lint).run(tree)
+    _LedgerTailLint(lint).run(tree, coll)
     return lint.findings
 
 

@@ -34,7 +34,7 @@ import dataclasses
 import json
 import os
 import time
-from typing import Callable, List, Optional
+from typing import Any, Callable, List, Optional
 
 from ray_lightning_tpu.autoscale.capacity import CapacityOracle
 from ray_lightning_tpu.autoscale.policy import (
@@ -49,6 +49,7 @@ __all__ = ["ControllerConfig", "AutoscaleController", "LEDGER_NAME",
            "read_ledger"]
 
 LEDGER_NAME = "autoscale.jsonl"
+LEDGER_VERSION = "rlt-autoscale-v1"
 
 
 @dataclasses.dataclass
@@ -69,26 +70,43 @@ class ControllerConfig:
     #: wall-clock poll cadence for `run_wall` (the scripted harness
     #: ignores this — it polls on virtual ticks)
     poll_every_s: float = 5.0
+    #: SLO watch (telemetry/watch.py, docs/OBSERVABILITY.md): True (or
+    #: a WatchConfig) evaluates the declarative rules on every poll —
+    #: the controller's cadence IS the watch cadence for a serving
+    #: session — with breaches landing in <run_dir>/incidents.jsonl
+    #: carrying the forced-flight-persist evidence capture. None: off.
+    watch: Any = None
 
 
-def read_ledger(run_dir: str) -> List[dict]:
+def read_ledger(run_dir: str,
+                tail_bytes: Optional[int] = None) -> List[dict]:
     """Parse ``<run_dir>/autoscale.jsonl`` (missing file = no
     decisions = []); unparseable lines are skipped, never fatal — a
-    killed controller must still leave a readable ledger prefix."""
+    killed controller must still leave a readable ledger prefix. The
+    clock-alignment header line is NOT an entry (the timeline adapter
+    reads it for the wall-axis placement). ``tail_bytes`` bounds the
+    read for cadence-polled callers (RLT503)."""
+    from ray_lightning_tpu.telemetry.spans import ledger_tail_lines
+
     path = os.path.join(run_dir, LEDGER_NAME)
     out: List[dict] = []
     try:
-        with open(path) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    out.append(json.loads(line))
-                except json.JSONDecodeError:
-                    continue
+        first, body = ledger_tail_lines(path, tail_bytes)
     except OSError:
-        pass
+        return out
+    for line in [first] + body:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if not isinstance(obj, dict):
+            continue
+        if "version" in obj and "decision" not in obj:
+            continue  # the clock-alignment header
+        out.append(obj)
     return out
 
 
@@ -131,6 +149,21 @@ class AutoscaleController:
         self.scale_up_s: List[float] = []
         self.ledger_path = (os.path.join(self.run_dir, LEDGER_NAME)
                             if self.run_dir else None)
+        #: clock-alignment pair stamped into the ledger header: every
+        #: entry's "t" is a perf_counter offset from t0_perf, so the
+        #: timeline merger places decisions on the shared wall axis
+        #: even when the POLICY clock is virtual (the scripted smoke)
+        self._t0_wall = time.time()
+        self._t0_perf = time.perf_counter()
+        self.watch = None
+        if self.cfg.watch and self.run_dir is not None:
+            from ray_lightning_tpu.telemetry.watch import (
+                WatchConfig, WatchEngine,
+            )
+
+            self.watch = WatchEngine(
+                self.run_dir, WatchConfig.coerce(self.cfg.watch),
+                driver=driver)
 
     # ---- inputs ----------------------------------------------------------
 
@@ -171,6 +204,11 @@ class AutoscaleController:
         entry = {
             "decision_index": self.decisions,
             "now": now,
+            # "t" is the REAL monotonic offset from the ledger header's
+            # t0_perf — "now" may be a virtual policy clock, and the
+            # timeline merge must never have to guess this ledger's
+            # epoch from it
+            "t": round(time.perf_counter() - self._t0_perf, 6),
             "signal": _signal_snapshot(signal or {}),
             "decision": decision.to_dict(),
             "outcome": outcome,
@@ -193,6 +231,11 @@ class AutoscaleController:
             fl.record("autoscale", action=decision.action,
                       target=decision.target, ok=outcome.get("ok"),
                       reason=decision.reason[:120])
+        if self.watch is not None:
+            # the controller's poll cadence doubles as the watch
+            # cadence: pure tail-bounded reads over already-persisted
+            # ledgers, breaches land in <run_dir>/incidents.jsonl
+            self.watch.poll(driver=self.driver)
         return entry
 
     def run_wall(self, max_duration_s: float,
@@ -306,4 +349,15 @@ class AutoscaleController:
             return
         os.makedirs(os.path.dirname(self.ledger_path), exist_ok=True)
         with open(self.ledger_path, "a") as f:
+            if f.tell() == 0:
+                # clock-alignment header (docs/OBSERVABILITY.md
+                # "unified timeline"): the same t0_wall/monotonic pair
+                # spans/metrics files carry, so the timeline merger
+                # never guesses this ledger's epoch
+                f.write(json.dumps({
+                    "version": LEDGER_VERSION,
+                    "t0_wall": self._t0_wall,
+                    "t0_perf": self._t0_perf,
+                    "pid": os.getpid(),
+                }) + "\n")
             f.write(json.dumps(entry) + "\n")

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import json
 import os
 import signal as _signal
 import time
@@ -101,6 +102,15 @@ class ResilienceConfig:
     #: in SupervisedResult.reshards with its honest batch plan. None
     #: (default): fixed world size, exactly the old behavior.
     elastic: Any = None
+    #: SLO watch (telemetry/watch.py, docs/OBSERVABILITY.md "watch
+    #: rules & incidents"): True (or a WatchConfig / rule tuple) arms
+    #: the declarative rule engine over the run's persisted evidence —
+    #: evaluated driver-side after every classified failure and at the
+    #: terminal bookkeeping, pure tail-bounded reads, ZERO effect on
+    #: the compiled program (same discipline as telemetry=off,
+    #: test-pinned). Breaches land in <checkpoint_dir>/incidents.jsonl
+    #: and in SupervisedResult.incidents. None (default): off.
+    watch: Any = None
 
     def resolved_compile_cache_dir(self) -> Optional[str]:
         if self.compile_cache_dir == "off":
@@ -134,8 +144,15 @@ class SupervisedResult:
     goodput: Optional[Dict[str, Any]] = None
     #: elastic world-size changes, launch order (docs/ELASTIC.md): one
     #: entry per shrink/grow with from/to world, reason, and the honest
-    #: batch plan (ElasticBudget.batch_plan)
+    #: batch plan (ElasticBudget.batch_plan). Also persisted append-only
+    #: to <checkpoint_dir>/reshards.jsonl with a clock-alignment header
+    #: (the timeline merger ingests it — docs/OBSERVABILITY.md)
     reshards: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list)
+    #: watch-rule breaches fired during supervision
+    #: (ResilienceConfig.watch; the on-disk record is
+    #: <checkpoint_dir>/incidents.jsonl)
+    incidents: List[Dict[str, Any]] = dataclasses.field(
         default_factory=list)
 
     @property
@@ -181,6 +198,34 @@ class RestartBudgetExceeded(SupervisedFailure):
             f"[{classified.kind}/{classified.cause}] {classified.detail}")
         self.classified = classified
         self.attempts = attempts
+
+
+#: on-disk reshard ledger beside the checkpoints — the elastic story's
+#: evidence stream (previously only in-memory on SupervisedResult)
+RESHARD_LEDGER = "reshards.jsonl"
+RESHARD_LEDGER_VERSION = "rlt-reshards-v1"
+
+
+def _append_reshard_ledger(directory: str, entry: Dict[str, Any]) -> None:
+    """Append one reshard entry (shrink/grow/grow_refused) to
+    ``<directory>/reshards.jsonl``, writing the clock-alignment header
+    first when creating the file — the same ``t0_wall``/monotonic
+    stamp every other ledger carries, so the timeline merger
+    (telemetry/timeline.py) never guesses this stream's epoch. Entries
+    additionally carry their own epoch ``at`` stamp. Best-effort: a
+    failed bookkeeping write must never cost the run its relaunch."""
+    try:
+        with open(os.path.join(directory, RESHARD_LEDGER), "a") as f:
+            if f.tell() == 0:
+                f.write(json.dumps({
+                    "version": RESHARD_LEDGER_VERSION,
+                    "t0_wall": time.time(),
+                    "t0_perf": time.perf_counter(),
+                    "pid": os.getpid(),
+                }) + "\n")
+            f.write(json.dumps(entry, default=str) + "\n")
+    except OSError:
+        log.exception("could not append to the reshard ledger")
 
 
 def _wrapped_trainer_factory(trainer_factory: Callable[[], Any],
@@ -347,6 +392,37 @@ def supervise(
     wall_t0 = time.perf_counter()
     backoff_s = 0.0
 
+    # SLO watch (telemetry/watch.py): driver-side rule evaluation over
+    # the run's persisted evidence — polled after every classified
+    # failure (restart-rate breaches fire mid-run, not post-mortem)
+    # and at the terminal bookkeeping (goodput_fraction sees the
+    # assembled report). Pure file reads: the workers' compiled
+    # program is untouched (test-pinned).
+    watch_engine = None
+    if kind == "fit" and cfg.watch:
+        from ray_lightning_tpu.telemetry.watch import (
+            WatchConfig,
+            WatchEngine,
+        )
+
+        # telemetry_dir threaded explicitly: a TelemetryConfig(dir=...)
+        # run keeps its spans/goodput ledgers OUTSIDE
+        # <checkpoint_dir>/telemetry, and the watch must read where
+        # they actually are
+        watch_engine = WatchEngine(cfg.checkpoint_dir,
+                                   WatchConfig.coerce(cfg.watch),
+                                   telemetry_dir=telemetry_dir)
+
+    def _watch_poll() -> List[Dict[str, Any]]:
+        if watch_engine is None:
+            return []
+        try:
+            watch_engine.poll()
+        except Exception:  # noqa: BLE001 — observability must never
+            # cost the run its result
+            log.exception("watch evaluation failed")
+        return list(watch_engine.incidents)
+
     def _assemble(restarts, preemptions, rollbacks):
         if telemetry_dir is None:
             return None
@@ -393,11 +469,12 @@ def supervise(
                               else None),
                     **kw,
                 )
+            goodput = _assemble(restarts, preemptions, rollbacks)
             return SupervisedResult(result, restarts, preemptions,
                                     failures, rollbacks, quarantined,
-                                    goodput=_assemble(
-                                        restarts, preemptions, rollbacks),
-                                    reshards=reshards)
+                                    goodput=goodput,
+                                    reshards=reshards,
+                                    incidents=_watch_poll())
         except BaseException as exc:
             if isinstance(exc, (KeyboardInterrupt, SystemExit)):
                 raise
@@ -406,10 +483,14 @@ def supervise(
                              "at": time.time()})
             log.warning("supervised attempt %d failed: [%s/%s] %s",
                         attempts, fc.kind, fc.cause, fc.detail)
+            # mid-run watch cadence: a restart-rate / guard-streak
+            # breach fires NOW, while an operator can still act on it
+            _watch_poll()
             if fc.kind == FailureKind.FATAL:
                 # land the driver's attempt/backoff spans for the
                 # post-mortem report before failing for good
                 _assemble(restarts, preemptions, rollbacks)
+                _watch_poll()
                 raise SupervisedFailure(fc, attempts) from exc
             allowed = policy.allows(restarts, preemptions, fc, rollbacks)
             new_world = None
@@ -430,9 +511,12 @@ def supervise(
                     # the oracle kept a shrunk run small: record its
                     # answer (worlds + source) in the reshard ledger —
                     # the capacity truth is auditable, never implicit
-                    reshards.append({**grow_refusal,
+                    refusal_entry = {**grow_refusal,
                                      "attempt": attempts,
-                                     "at": time.time()})
+                                     "at": time.time()}
+                    reshards.append(refusal_entry)
+                    _append_reshard_ledger(cfg.checkpoint_dir,
+                                           refusal_entry)
                     log.warning(
                         "supervise: grow %d -> %d refused — capacity "
                         "oracle (%s) reports %s schedulable world(s)",
@@ -441,6 +525,7 @@ def supervise(
                         grow_refusal["capacity"])
             if new_world is None and not allowed:
                 _assemble(restarts, preemptions, rollbacks)
+                _watch_poll()
                 raise RestartBudgetExceeded(
                     fc, attempts,
                     policy.max_rollbacks
@@ -481,10 +566,12 @@ def supervise(
                         # fail with the classified cause, the refusal
                         # chained underneath
                         _assemble(restarts, preemptions, rollbacks)
+                        _watch_poll()
                         raise RestartBudgetExceeded(
                             fc, attempts, policy.max_restarts) from rexc
                 else:
                     reshards.append(entry)
+                    _append_reshard_ledger(cfg.checkpoint_dir, entry)
                     world = new_world
                     monitor = _make_monitor(world)
             log.warning(

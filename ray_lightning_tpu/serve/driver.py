@@ -998,6 +998,30 @@ class ServeDriver:
             rep.sched.busy() for rep in self.replicas.values()
             if rep.state != "stopped")
 
+    def force_flight_persist(self) -> int:
+        """Incident-capture seam (telemetry/incidents.py,
+        docs/OBSERVABILITY.md "incident capture"): persist every
+        non-stopped replica's flight ring plus the driver ring NOW,
+        instead of waiting out the persist cadence — a watch-rule
+        breach self-documents with the breach window's final ticks on
+        disk even if the process dies next. Host-side file writes
+        only; returns how many rings landed. Safe outside a session
+        (the fixed-batch ``run()`` owns its recorders internally):
+        persists whatever the driver holds, possibly nothing."""
+        persisted = 0
+        for rep in self.replicas.values():
+            if rep.state == "stopped":
+                continue
+            fl = rep.sched.flight
+            if getattr(fl, "enabled", False):
+                fl.persist()
+                persisted += 1
+        fl = self.driver_flight
+        if fl is not None and getattr(fl, "enabled", False):
+            fl.persist()
+            persisted += 1
+        return persisted
+
     def stop(self, drain: bool = True) -> ServeResult:
         """End the session. ``drain`` ticks until every stream
         completes first; ``drain=False`` accounts in-flight work as

@@ -13,6 +13,16 @@ and the static-vs-measured reconciliation report (docs/OBSERVABILITY.md).
                marker-file / SIGUSR1 jax.profiler capture, rank-scoped
   report.py    `python -m ray_lightning_tpu report|monitor` — timeline,
                goodput, and the drift join against tracecheck
+  timeline.py  unified run timeline: every evidence ledger merged into
+               one causally-ordered Event stream + Chrome-trace export
+               (docs/OBSERVABILITY.md "unified timeline")
+  watch.py     declarative SLO watch rules evaluated over the persisted
+               surfaces (ttft_p99, goodput, queue pressure, guard
+               streaks, restart rate)
+  incidents.py automatic incident capture: a rule breach appends a
+               self-documenting record (evidence + timeline excerpt)
+               to <run_dir>/incidents.jsonl and actuates the profiler
+               marker + flight-persist evidence hooks
 """
 from ray_lightning_tpu.telemetry.goodput import (  # noqa: F401
     GOODPUT_BUCKETS,
@@ -39,12 +49,29 @@ from ray_lightning_tpu.telemetry.profiler import (  # noqa: F401
     ProfileConfig,
     ProfilerController,
 )
+from ray_lightning_tpu.telemetry.incidents import (  # noqa: F401
+    append_incident,
+    capture_evidence,
+    read_incidents,
+)
 from ray_lightning_tpu.telemetry.spans import (  # noqa: F401
     NULL_RECORDER,
     PHASES,
     NullRecorder,
     TelemetryRecorder,
+    ledger_tail_lines,
     read_spans,
+)
+from ray_lightning_tpu.telemetry.timeline import (  # noqa: F401
+    Event,
+    load_timeline_events,
+    to_chrome_trace,
+)
+from ray_lightning_tpu.telemetry.watch import (  # noqa: F401
+    BUILTIN_RULES,
+    WatchConfig,
+    WatchEngine,
+    WatchRule,
 )
 
 __all__ = [
@@ -52,10 +79,14 @@ __all__ = [
     "buckets_consistent", "read_goodput", "worker_ledger",
     "write_goodput", "write_ledger", "ProfileConfig",
     "ProfilerController", "NULL_RECORDER", "PHASES", "NullRecorder",
-    "TelemetryRecorder", "TelemetryConfig", "read_spans",
+    "TelemetryRecorder", "TelemetryConfig", "ledger_tail_lines",
+    "read_spans",
     "NULL_FLIGHT", "NULL_METRICS", "FlightRecorder", "Histogram",
     "MetricsRegistry", "NullMetrics", "merge_histograms", "read_flight",
     "read_metrics",
+    "Event", "load_timeline_events", "to_chrome_trace",
+    "BUILTIN_RULES", "WatchConfig", "WatchEngine", "WatchRule",
+    "append_incident", "capture_evidence", "read_incidents",
 ]
 
 

@@ -412,41 +412,50 @@ class NullMetrics:
 NULL_METRICS = NullMetrics()
 
 
-def read_metrics(path: str) -> Dict[str, Any]:
+def read_metrics(path: str,
+                 tail_bytes: Optional[int] = None) -> Dict[str, Any]:
     """Parse one replica's metrics JSONL: ``{"header": {...}, "ticks":
     [...], "hists": {name: Histogram}, "counters": {...}, "gauges":
     {...}, "dropped": n}``. ``counters``/``gauges`` are the newest tick
     sample's (cumulative counters — the file's final word). Unparseable
     lines are counted, not fatal: a SIGKILL mid-flush must still report
-    what landed."""
+    what landed. ``tail_bytes`` bounds the read to the header + the
+    file's last N bytes (RLT503 — the newest ticks and the LAST
+    cumulative ``hists`` snapshot both live at the end, so the live
+    views this serves lose nothing)."""
+    from ray_lightning_tpu.telemetry.spans import ledger_tail_lines
+
     header: Dict[str, Any] = {}
     ticks: List[dict] = []
     hists: Dict[str, Histogram] = {}
     dropped = 0
     bad = 0
-    with open(path) as f:
-        for i, line in enumerate(f):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                obj = json.loads(line)
-            except json.JSONDecodeError:
-                bad += 1
-                continue
-            if i == 0 and obj.get("version") == METRICS_VERSION:
-                header = obj
-                continue
-            if "_dropped" in obj:
-                dropped += int(obj["_dropped"])
-                continue
-            if "hists" in obj:
-                # cumulative snapshots: the last one wins
-                hists = {name: Histogram.from_dict(d)
-                         for name, d in obj["hists"].items()}
-                continue
-            if "tick" in obj:
-                ticks.append(obj)
+    first, body = ledger_tail_lines(path, tail_bytes)
+    for i, line in enumerate([first] + body):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            bad += 1
+            continue
+        if not isinstance(obj, dict):
+            bad += 1
+            continue
+        if i == 0 and obj.get("version") == METRICS_VERSION:
+            header = obj
+            continue
+        if "_dropped" in obj:
+            dropped += int(obj["_dropped"])
+            continue
+        if "hists" in obj:
+            # cumulative snapshots: the last one wins
+            hists = {name: Histogram.from_dict(d)
+                     for name, d in obj["hists"].items()}
+            continue
+        if "tick" in obj:
+            ticks.append(obj)
     last = ticks[-1] if ticks else {}
     return {"header": header, "ticks": ticks, "hists": hists,
             "counters": dict(last.get("c") or {}),
@@ -491,15 +500,19 @@ def quantile_block(hist: Histogram) -> dict:
     }
 
 
-def read_all_metrics(directory: str) -> List[Dict[str, Any]]:
+def read_all_metrics(directory: str,
+                     tail_bytes: Optional[int] = None
+                     ) -> List[Dict[str, Any]]:
     """Parse every replica metrics JSONL under ``directory`` once —
     the shared substrate of `aggregate_from_parsed` and
     `newest_from_parsed`, so one report/summary pass never re-reads a
-    file."""
+    file. ``tail_bytes`` bounds each file's read (cadence-polled
+    callers: the load signal, `monitor --follow`, watch evaluation —
+    RLT503)."""
     out: List[Dict[str, Any]] = []
     for path in metrics_paths(directory):
         try:
-            out.append(read_metrics(path))
+            out.append(read_metrics(path, tail_bytes=tail_bytes))
         except OSError:
             continue
     return out
@@ -599,22 +612,40 @@ def newest_from_parsed(
     return newest
 
 
-def newest_metrics_per_replica(directory: str) -> Dict[str, dict]:
+def newest_metrics_per_replica(directory: str,
+                               tail_bytes: Optional[int] = None
+                               ) -> Dict[str, dict]:
     """`newest_from_parsed` over a directory — the substrate of
     `load_signal_from_dir` and `monitor --serve`; callers that also
     aggregate should `read_all_metrics` once and use the
     ``_from_parsed`` forms so no file is parsed twice."""
-    return newest_from_parsed(read_all_metrics(directory))
+    return newest_from_parsed(
+        read_all_metrics(directory, tail_bytes=tail_bytes))
+
+
+def signal_tail_bytes(window: int) -> int:
+    """The per-file read bound a ``window``-tick signal needs: the
+    newest ``window`` samples plus the trailing hists/gauge lines, with
+    generous slack per line. The load signal only ever summarizes the
+    window, so bounding the READ to it is lossless — and keeps every
+    cadence-polled signal read O(window), not O(run length) (RLT503)."""
+    return max(64 * 1024, int(window) * 1024)
 
 
 def load_signal_from_dir(directory: str,
-                         window: int = LOAD_SIGNAL_WINDOW) -> dict:
+                         window: int = LOAD_SIGNAL_WINDOW,
+                         tail_bytes: Optional[int] = None) -> dict:
     """The queue-depth/occupancy oracle summary over the newest metrics
     file per replica — `serve.driver.load_signal` is the documented
-    run-dir-level wrapper (docs/OBSERVABILITY.md "load signal")."""
+    run-dir-level wrapper (docs/OBSERVABILITY.md "load signal"). Reads
+    are tail-bounded by default (`signal_tail_bytes(window)`): the
+    signal is a rolling-window summary, so a cadence-polled read never
+    needs the whole ledger."""
+    if tail_bytes is None:
+        tail_bytes = signal_tail_bytes(window)
     return load_signal_from_parsed(
-        newest_metrics_per_replica(directory), window=window,
-        where=directory)
+        newest_metrics_per_replica(directory, tail_bytes=tail_bytes),
+        window=window, where=directory)
 
 
 def load_signal_from_parsed(newest_per_replica: Dict[str, dict],

@@ -66,17 +66,20 @@ def telemetry_dir(run_dir: str) -> str:
     return os.path.join(run_dir, "telemetry")
 
 
-def load_timeline(run_dir: str) -> Dict[str, Any]:
+def load_timeline(run_dir: str,
+                  tail_bytes: Optional[int] = None) -> Dict[str, Any]:
     """Assemble the clock-aligned cross-rank view from the span files.
     Restarted attempts leave one pid-tagged file each per rank — they
     are merged in wall-clock order (totals accumulate; the "current"
-    phase comes from the newest attempt)."""
+    phase comes from the newest attempt). ``tail_bytes`` bounds each
+    file's read — the cadence-polled `monitor --follow` path threads a
+    bound here (RLT503); the one-shot report reads everything."""
     tdir = telemetry_dir(run_dir)
     ranks: Dict[int, Dict[str, Any]] = {}
     paths = sorted(glob.glob(os.path.join(tdir, "rank*.spans.jsonl")))
     parsed_files = []
     for path in paths:
-        parsed = read_spans(path)
+        parsed = read_spans(path, tail_bytes=tail_bytes)
         rank = int(parsed["header"].get("rank", -1)) \
             if parsed["header"] else -1
         t0 = (parsed["header"] or {}).get("t0_wall") or 0.0
@@ -371,8 +374,9 @@ def build_serving_section(run_dir: str) -> Optional[Dict[str, Any]]:
     return section
 
 
-def build_autoscale_section(base: str,
-                            tdir: str) -> Optional[Dict[str, Any]]:
+def build_autoscale_section(base: str, tdir: str,
+                            tail_bytes: Optional[int] = None
+                            ) -> Optional[Dict[str, Any]]:
     """The controller's decision ledger, summarized
     (``<run_dir>/autoscale.jsonl``, docs/AUTOSCALE.md): decision/event
     counts, spawn retries, the final replica count, the last decision
@@ -384,7 +388,7 @@ def build_autoscale_section(base: str,
         driver_metrics_paths, read_metrics,
     )
 
-    entries = read_ledger(base)
+    entries = read_ledger(base, tail_bytes=tail_bytes)
     if not entries:
         return None
 
@@ -422,7 +426,7 @@ def build_autoscale_section(base: str,
     counters: Dict[str, int] = {}
     for path in driver_metrics_paths(tdir):
         try:
-            parsed = read_metrics(path)
+            parsed = read_metrics(path, tail_bytes=tail_bytes)
         except OSError:
             continue
         for name, v in parsed["counters"].items():
@@ -431,6 +435,79 @@ def build_autoscale_section(base: str,
         section["driver_counters"] = counters
         if "submit_deferrals" in counters:
             section["submit_deferrals"] = counters["submit_deferrals"]
+    return section
+
+
+#: evidence stream name -> (where, glob/file) — the detection table the
+#: structured partial report names missing streams from. A run dir
+#: that holds only a SUBSET (a run killed before the first span flush,
+#: an autoscale-only dir) degrades to a partial report naming the gap,
+#: never a traceback (test-pinned, docs/OBSERVABILITY.md).
+EVIDENCE_STREAMS = (
+    ("spans", "telemetry", "rank*.spans.jsonl"),
+    ("goodput", "telemetry", "goodput.json"),
+    ("metrics", "telemetry", "*.metrics.jsonl"),
+    ("flight", "both", "*flight.json"),
+    ("autoscale", "run", "autoscale.jsonl"),
+    ("reshard", "both", "reshards.jsonl"),
+    ("incidents", "run", "incidents.jsonl"),
+    ("serving", "run", "serving.json"),
+)
+
+
+def detect_streams(run_dir: str, tdir: str) -> Dict[str, List[str]]:
+    """Which evidence streams this run dir actually holds — the
+    report's honesty header: a partial report SAYS what is missing
+    instead of silently rendering empty sections."""
+    base = run_dir if tdir != run_dir else os.path.dirname(run_dir)
+    present: List[str] = []
+    missing: List[str] = []
+    for name, where, pattern in EVIDENCE_STREAMS:
+        dirs = {"telemetry": (tdir,), "run": (base,),
+                "both": (base, tdir)}[where]
+        found = any(glob.glob(os.path.join(d, pattern)) for d in dirs)
+        (present if found else missing).append(name)
+    return {"present": present, "missing": missing}
+
+
+def build_incidents_section(run_dir: str,
+                            tail_bytes: Optional[int] = None
+                            ) -> Optional[Dict[str, Any]]:
+    """The incident ledger, summarized (telemetry/incidents.py,
+    docs/OBSERVABILITY.md "watch rules & incidents"). None when the
+    run never ran a watch (or nothing fired and no ledger exists)."""
+    from ray_lightning_tpu.telemetry.incidents import read_incidents
+
+    tdir = telemetry_dir(run_dir)
+    base = run_dir if tdir != run_dir else os.path.dirname(run_dir)
+    parsed = read_incidents(base, tail_bytes=tail_bytes)
+    if not parsed["incidents"] and not parsed["header"]:
+        return None
+    by_rule: Dict[str, int] = {}
+    by_sev: Dict[str, int] = {}
+    for inc in parsed["incidents"]:
+        by_rule[inc.get("rule", "?")] = \
+            by_rule.get(inc.get("rule", "?"), 0) + 1
+        by_sev[inc.get("severity", "?")] = \
+            by_sev.get(inc.get("severity", "?"), 0) + 1
+    section: Dict[str, Any] = {
+        "count": len(parsed["incidents"]),
+        "by_rule": by_rule,
+        "by_severity": by_sev,
+        "unparseable_lines": parsed["unparseable_lines"],
+    }
+    if parsed["incidents"]:
+        last = parsed["incidents"][-1]
+        section["last"] = {
+            "rule": last.get("rule"),
+            "severity": last.get("severity"),
+            "wall": last.get("wall"),
+            "evidence": {k: (last.get("evidence") or {}).get(k)
+                         for k in ("metric", "value", "op",
+                                   "threshold")},
+            "actions": sorted(last.get("actions") or {}),
+            "excerpt_events": len(last.get("timeline_excerpt") or []),
+        }
     return section
 
 
@@ -447,10 +524,14 @@ def build_report(run_dir: str, preset: Optional[str] = None,
             str(r): v["phase_totals"]
             for r, v in sorted(timeline["ranks"].items())},
         "goodput": gp.read_goodput(timeline["telemetry_dir"]),
+        "streams": detect_streams(run_dir, timeline["telemetry_dir"]),
     }
     serving = build_serving_section(run_dir)
     if serving:
         out["serving"] = serving
+    incidents = build_incidents_section(run_dir)
+    if incidents:
+        out["incidents"] = incidents
     if preset:
         predicted = predicted_step_composition(preset, topo, overlap)
         out["drift"] = build_drift(predicted, timeline, threshold)
@@ -459,6 +540,26 @@ def build_report(run_dir: str, preset: Optional[str] = None,
 
 def _print_report(out: Dict[str, Any]) -> None:
     print(f"telemetry report: {out['run_dir']}")
+    streams = out.get("streams") or {}
+    if streams:
+        missing = streams.get("missing") or []
+        print(f"streams: {', '.join(streams.get('present') or ['none'])}"
+              + (f" (missing: {', '.join(missing)})" if missing
+                 else ""))
+    inc = out.get("incidents")
+    if inc:
+        by_rule = ", ".join(f"{r}x{n}" for r, n in
+                            sorted(inc["by_rule"].items()))
+        print(f"incidents: {inc['count']} ({by_rule})")
+        last = inc.get("last") or {}
+        if last:
+            ev = last.get("evidence") or {}
+            print(f"  last: [{last.get('severity')}] "
+                  f"{last.get('rule')} — {ev.get('metric')} = "
+                  f"{ev.get('value')} {ev.get('op')} "
+                  f"{ev.get('threshold')}; "
+                  f"{last.get('excerpt_events')} excerpt event(s), "
+                  f"actions: {', '.join(last.get('actions') or []) or 'none'}")
     g = out.get("goodput")
     if g:
         print(f"goodput: {g['goodput_fraction']:.1%} of "
@@ -622,8 +723,15 @@ def add_monitor_parser(sub) -> None:
                    default=argparse.SUPPRESS)
 
 
-def _monitor_once(run_dir: str) -> Dict[str, Any]:
-    timeline = load_timeline(run_dir)
+#: per-ledger read bound for the cadence-polled monitor views — the
+#: live view needs the newest spans/ticks, never the whole run history
+#: (RLT503; one-shot `report` still reads everything)
+MONITOR_TAIL_BYTES = 1 << 20
+
+
+def _monitor_once(run_dir: str,
+                  tail_bytes: Optional[int] = None) -> Dict[str, Any]:
+    timeline = load_timeline(run_dir, tail_bytes=tail_bytes)
     now = time.time()
     view: Dict[str, Any] = {"run_dir": run_dir, "ranks": {}}
     for rank, info in sorted(timeline["ranks"].items()):
@@ -640,10 +748,15 @@ def _monitor_once(run_dir: str) -> Dict[str, Any]:
         }
     view["goodput"] = gp.read_goodput(timeline["telemetry_dir"])
     view["step_stats"] = timeline["step_stats"]
+    inc = build_incidents_section(run_dir, tail_bytes=tail_bytes)
+    if inc:
+        view["incidents"] = inc["count"]
     return view
 
 
-def _monitor_serve_once(run_dir: str) -> Dict[str, Any]:
+def _monitor_serve_once(run_dir: str,
+                        tail_bytes: Optional[int] = None
+                        ) -> Dict[str, Any]:
     """One sample of the live serving view: the newest metrics file per
     replica, its latest flushed tick, a token rate over the recent
     window, and the load signal — everything `monitor --serve` renders.
@@ -656,8 +769,9 @@ def _monitor_serve_once(run_dir: str) -> Dict[str, Any]:
     tdir = telemetry_dir(run_dir)
     view: Dict[str, Any] = {"run_dir": run_dir, "replicas": {}}
     # ONE parse pass serves both the per-replica view and the load
-    # signal — a --follow refresh re-reads each file once, not twice
-    newest = newest_metrics_per_replica(tdir)
+    # signal — a --follow refresh re-reads each file once, not twice,
+    # and reads only each ledger's tail (RLT503)
+    newest = newest_metrics_per_replica(tdir, tail_bytes=tail_bytes)
     now = time.time()
     for rep, entry in sorted(newest.items()):
         parsed = entry["parsed"]
@@ -692,9 +806,12 @@ def _monitor_serve_once(run_dir: str) -> Dict[str, Any]:
         }
     view["load_signal"] = load_signal_from_parsed(newest, where=tdir)
     base = run_dir if tdir != run_dir else os.path.dirname(run_dir)
-    asc = build_autoscale_section(base, tdir)
+    asc = build_autoscale_section(base, tdir, tail_bytes=tail_bytes)
     if asc:
         view["autoscale"] = asc
+    inc = build_incidents_section(run_dir, tail_bytes=tail_bytes)
+    if inc:
+        view["incidents"] = inc["count"]
     return view
 
 
@@ -728,6 +845,9 @@ def _print_serve_view(view: Dict[str, Any]) -> None:
               f"({asc['scale_ups']} up / {asc['scale_downs']} down); "
               f"last: {ld.get('action')} — "
               f"{(ld.get('reason') or '')[:70]}")
+    if view.get("incidents"):
+        print(f"  incidents: {view['incidents']} (see `report` / "
+              "incidents.jsonl)")
 
 
 def run_monitor(args) -> int:
@@ -737,9 +857,12 @@ def run_monitor(args) -> int:
         print("error: pass a run dir or --smoke", file=sys.stderr)
         return 2
     as_json = getattr(args, "as_json", False)
+    # --follow polls on a cadence: every ledger read is tail-bounded
+    # (the one-shot view reads everything — it runs once)
+    tail = MONITOR_TAIL_BYTES if args.follow else None
     if getattr(args, "serve", False):
         while True:
-            view = _monitor_serve_once(args.run_dir)
+            view = _monitor_serve_once(args.run_dir, tail_bytes=tail)
             if as_json:
                 print(json.dumps(view), flush=True)
             else:
@@ -748,13 +871,15 @@ def run_monitor(args) -> int:
                 return 0
             time.sleep(max(0.2, args.interval))
     while True:
-        view = _monitor_once(args.run_dir)
+        view = _monitor_once(args.run_dir, tail_bytes=tail)
         if as_json:
             print(json.dumps(view), flush=True)
         else:
             ss = view.get("step_stats")
             extra = (f"  warm step {ss['mean_s'] * 1e3:.1f} ms"
                      if ss else "")
+            if view.get("incidents"):
+                extra += f"  [{view['incidents']} incident(s)]"
             print(f"-- {time.strftime('%H:%M:%S')} {args.run_dir}{extra}")
             for rank, info in view["ranks"].items():
                 print(f"  rank {rank}: phase={info['phase']} "

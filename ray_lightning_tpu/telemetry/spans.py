@@ -329,30 +329,65 @@ class NullRecorder:
 NULL_RECORDER = NullRecorder()
 
 
-def read_spans(path: str) -> Dict[str, Any]:
+def ledger_tail_lines(path: str,
+                      tail_bytes: Optional[int] = None):
+    """``(first_line, body_lines)`` for one JSONL ledger. The first
+    line is returned separately because it is the clock-alignment
+    header slot — a TAIL-bounded read (``tail_bytes``) must never lose
+    it, or the timeline merge would have to guess the ledger's epoch.
+    With a bound, only the last ``tail_bytes`` of the body are read
+    (the partial line at the window's cut edge is dropped) — the
+    RLT503 discipline for cadence-polled readers (`monitor --follow`,
+    watch evaluation): a week-old multi-GiB ledger costs a poll one
+    seek + one bounded read, not a full parse."""
+    with open(path, "rb") as f:
+        first = f.readline()
+        header_end = f.tell()
+        if tail_bytes is None:
+            body = f.read()
+        else:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            start = max(header_end, size - max(0, int(tail_bytes)))
+            f.seek(start)
+            body = f.read()
+            if start > header_end:
+                nl = body.find(b"\n")
+                body = body[nl + 1:] if nl >= 0 else b""
+    return (first.decode("utf-8", "replace"),
+            body.decode("utf-8", "replace").splitlines())
+
+
+def read_spans(path: str,
+               tail_bytes: Optional[int] = None) -> Dict[str, Any]:
     """Parse one rank's spans JSONL: ``{"header": {...}, "spans": [...],
     "dropped": n}``. Unparseable lines are counted, not fatal — a file
-    truncated by a kill mid-flush must still report what landed."""
+    truncated by a kill mid-flush must still report what landed.
+    ``tail_bytes`` bounds the read to the header + the file's last N
+    bytes (cadence-polled callers: RLT503)."""
     header: Dict[str, Any] = {}
     spans: List[dict] = []
     dropped = 0
     bad = 0
-    with open(path) as f:
-        for i, line in enumerate(f):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                obj = json.loads(line)
-            except json.JSONDecodeError:
-                bad += 1
-                continue
-            if i == 0 and obj.get("version") == SPANS_VERSION:
-                header = obj
-                continue
-            if obj.get("phase") == "_dropped":
-                dropped += int(obj.get("count", 0))
-                continue
-            spans.append(obj)
+    first, body = ledger_tail_lines(path, tail_bytes)
+    for i, line in enumerate([first] + body):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            bad += 1
+            continue
+        if not isinstance(obj, dict):
+            bad += 1
+            continue
+        if i == 0 and obj.get("version") == SPANS_VERSION:
+            header = obj
+            continue
+        if obj.get("phase") == "_dropped":
+            dropped += int(obj.get("count", 0))
+            continue
+        spans.append(obj)
     return {"header": header, "spans": spans, "dropped": dropped,
             "unparseable_lines": bad}

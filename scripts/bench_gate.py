@@ -30,6 +30,11 @@ Bounded metrics (upper limits, not ratchets):
     scale_up_s                   autoscale add_replica actuation wall
                                  (ISSUE 13; RLT_BENCH_SCALE_UP_MAX
                                  overrides, skip/null waives)
+    incidents                    watch-rule breaches fired against the
+                                 bench's own serving drill (ISSUE 14:
+                                 a healthy bench fires zero; any
+                                 incident in the bench run itself is a
+                                 regression — skip/null waived)
 
 Gate semantics:
 
@@ -146,6 +151,13 @@ BOUNDED = {
     # stopped hitting). Skip/null waived like every bound.
     "scale_up_s": float(
         os.environ.get("RLT_BENCH_SCALE_UP_MAX", 120.0)),
+    # watch incidents (ISSUE 14): the bench arms the built-in SLO
+    # rules over its own autoscale-drill run dir. The bound is ZERO:
+    # any rule breach inside the bench's own controlled serving run is
+    # a regression with a self-documenting incident record to read,
+    # never acceptable noise. Skip lines and null/absent counts waive
+    # (the drill degraded to autoscale_error and said so).
+    "incidents": float(os.environ.get("RLT_BENCH_INCIDENTS_MAX", 0.0)),
 }
 
 
@@ -319,6 +331,11 @@ def gate(fresh: dict, best: dict, tolerance: float,
                 "telemetry_overhead_fraction":
                     "telemetry is eating the step time it exists to "
                     "measure",
+                "incidents":
+                    "the bench's own serving drill breached a watch "
+                    "rule — read the incident record(s) in the drill "
+                    "run dir's incidents.jsonl excerpt for the "
+                    "self-documented evidence",
                 "ttft_p99_s":
                     "the steady-state TTFT tail blew its SLO bound — "
                     "queueing/prefill latency grew on the serving hot "

@@ -1,0 +1,470 @@
+"""Unified run timeline (telemetry/timeline.py, docs/OBSERVABILITY.md
+"unified timeline"): every evidence-ledger fixture round-trips into
+Events with source/rank/ordering asserted, garbage lines are skipped
+with a count, legacy headerless ledgers ingest with the unaligned tag
+(never a crash), the Chrome-trace export validates against the
+trace-event schema, and `report`/`monitor` degrade to a structured
+partial report on a partial or empty run dir."""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from ray_lightning_tpu.telemetry.incidents import append_incident
+from ray_lightning_tpu.telemetry.metrics import (
+    FlightRecorder,
+    MetricsRegistry,
+    finalize_flight,
+)
+from ray_lightning_tpu.telemetry.spans import (
+    TelemetryRecorder,
+    ledger_tail_lines,
+)
+from ray_lightning_tpu.telemetry.timeline import (
+    load_timeline_events,
+    render_text,
+    timeline_excerpt,
+    to_chrome_trace,
+    validate_chrome_trace,
+)
+
+
+def _tdir(run_dir: str) -> str:
+    return os.path.join(run_dir, "telemetry")
+
+
+# -------------------------------------------------- per-ledger round-trips
+
+
+def test_spans_roundtrip(tmp_path):
+    run = str(tmp_path)
+    rec = TelemetryRecorder(_tdir(run), rank=0)
+    with rec.span("compile", step=0):
+        time.sleep(0.002)
+    with rec.span("step", step=1):
+        time.sleep(0.001)
+    rec.close()
+    tl = load_timeline_events(run)
+    spans = [e for e in tl["events"] if e.source == "spans"]
+    assert [e.kind for e in spans] == ["compile", "step"]
+    assert all(e.rank == 0 and e.aligned for e in spans)
+    assert all(e.dur_s > 0 for e in spans)
+    # wall reconstruction: header t0_wall + offset lands near now
+    assert abs(spans[0].wall - time.time()) < 60
+    assert spans[0].wall <= spans[1].wall
+
+
+def test_metrics_roundtrip(tmp_path):
+    run = str(tmp_path)
+    reg = MetricsRegistry(_tdir(run), replica=1, flush_every_n_ticks=1)
+    reg.gauge("queue_depth", 3.0)
+    reg.tick_end()
+    reg.gauge("queue_depth", 1.0)
+    reg.tick_end()
+    reg.close()
+    tl = load_timeline_events(run)
+    ticks = [e for e in tl["events"] if e.source == "metrics"]
+    assert len(ticks) == 2
+    assert all(e.replica == 1 and e.kind == "tick" and e.aligned
+               for e in ticks)
+    assert ticks[0].payload["queue_depth"] == 3.0
+
+
+def test_flight_roundtrip(tmp_path):
+    run = str(tmp_path)
+    fpath = os.path.join(_tdir(run), "replica0.flight.json")
+    fl = FlightRecorder(fpath, replica=0, persist_every=1)
+    fl.record("admit", rid="r0")
+    fl.record("retire", rid="r0")
+    fl.close()
+    finalize_flight(_tdir(run), 0,
+                    {"kind": "retryable", "cause": "worker-signal"},
+                    os.path.join(run, "flight.json"))
+    tl = load_timeline_events(run)
+    flight = [e for e in tl["events"] if e.source == "flight"]
+    kinds = [e.kind for e in flight]
+    # the live ring AND the finalized dump's copy both ingest, plus
+    # the classified death stamp
+    assert "admit" in kinds and "retire" in kinds and "death" in kinds
+    death = next(e for e in flight if e.kind == "death")
+    assert death.payload["kind"] == "retryable"
+    assert all(e.aligned for e in flight)
+
+
+def test_autoscale_roundtrip_aligned(tmp_path):
+    from ray_lightning_tpu.autoscale.controller import (
+        AutoscaleController,
+        ControllerConfig,
+        read_ledger,
+    )
+
+    run = str(tmp_path)
+
+    class _Drv:
+        n_live = 1
+        driver_metrics = None
+        driver_flight = None
+
+    ctl = AutoscaleController(
+        _Drv(), ControllerConfig(), run_dir=run,
+        signal_fn=lambda: {"available": False, "reason": "test"})
+    ctl.step(now=0.0)
+    ctl.step(now=1.0)
+    # the ledger opens with the clock-alignment header; read_ledger
+    # skips it, the raw file carries it
+    first, _body = ledger_tail_lines(os.path.join(run,
+                                                  "autoscale.jsonl"))
+    header = json.loads(first)
+    assert header["version"] == "rlt-autoscale-v1"
+    assert header["t0_wall"] > 0 and "t0_perf" in header
+    entries = read_ledger(run)
+    assert len(entries) == 2 and all("t" in e for e in entries)
+    tl = load_timeline_events(run)
+    asc = [e for e in tl["events"] if e.source == "autoscale"]
+    assert len(asc) == 2
+    assert all(e.aligned and e.kind == "hold" for e in asc)
+    assert abs(asc[0].wall - header["t0_wall"]) < 60
+
+
+def test_autoscale_legacy_headerless_unaligned(tmp_path):
+    """A pre-PR-14 ledger (no header, no per-entry "t") must ingest
+    with the unaligned tag on its policy-clock offsets — present and
+    ordered among its peers, never a crash, never a guessed epoch."""
+    run = str(tmp_path)
+    with open(os.path.join(run, "autoscale.jsonl"), "w") as f:
+        for now in (4.0, 6.0):
+            f.write(json.dumps({
+                "decision_index": 0, "now": now,
+                "decision": {"action": "scale_up", "target": 2,
+                             "delta": 1, "reason": "legacy"},
+                "outcome": {"ok": True}, "replicas": 2,
+            }) + "\n")
+    tl = load_timeline_events(run)
+    asc = [e for e in tl["events"] if e.source == "autoscale"]
+    assert len(asc) == 2
+    assert all(not e.aligned for e in asc)
+    assert [e.wall for e in asc] == [4.0, 6.0]
+    assert tl["unaligned"] == 2
+    # unaligned events sort AFTER the aligned stream
+    assert tl["events"][-2:] == asc
+
+
+def test_reshard_ledger_roundtrip(tmp_path):
+    from ray_lightning_tpu.resilience.supervisor import (
+        _append_reshard_ledger,
+    )
+
+    run = str(tmp_path)
+    _append_reshard_ledger(run, {
+        "from_world": 2, "to_world": 1, "reason": "shrink",
+        "attempt": 2, "at": time.time(),
+        "batch_plan": {"note": "re-planned"}})
+    _append_reshard_ledger(run, {
+        "reason": "grow_refused", "from_world": 1, "resolved_max": 2,
+        "capacity": 1, "capacity_source": "file",
+        "attempt": 3, "at": time.time()})
+    first, body = ledger_tail_lines(os.path.join(run, "reshards.jsonl"))
+    assert json.loads(first)["version"] == "rlt-reshards-v1"
+    assert len(body) == 2
+    tl = load_timeline_events(run)
+    rs = [e for e in tl["events"] if e.source == "reshard"]
+    assert [e.kind for e in rs] == ["shrink", "grow_refused"]
+    assert all(e.aligned for e in rs)
+    assert rs[0].payload["from_world"] == 2
+    assert rs[1].payload["capacity_source"] == "file"
+
+
+def test_goodput_ledger_roundtrip(tmp_path):
+    from ray_lightning_tpu.telemetry.goodput import (
+        worker_ledger,
+        write_ledger,
+    )
+    from ray_lightning_tpu.telemetry.spans import NULL_RECORDER
+
+    run = str(tmp_path)
+    led = worker_ledger(NULL_RECORDER, 2.0, rank=0, start_step=0,
+                        end_step=10)
+    write_ledger(_tdir(run), led, uid="1-0")
+    tl = load_timeline_events(run)
+    attempts = [e for e in tl["events"] if e.source == "goodput"]
+    assert len(attempts) == 1
+    assert attempts[0].kind == "attempt" and attempts[0].rank == 0
+    assert attempts[0].dur_s == 2.0
+    assert attempts[0].payload["end_step"] == 10
+
+
+def test_incidents_roundtrip(tmp_path):
+    run = str(tmp_path)
+    append_incident(run, {"rule": "ttft_p99", "severity": "page",
+                          "wall": time.time(),
+                          "evidence": {"value": 3.0, "threshold": 2.0}})
+    tl = load_timeline_events(run)
+    inc = [e for e in tl["events"] if e.source == "incident"]
+    assert len(inc) == 1 and inc[0].kind == "ttft_p99"
+    assert inc[0].aligned and inc[0].payload["value"] == 3.0
+
+
+# ------------------------------------------------- merge-level properties
+
+
+def _multi_source_dir(tmp_path) -> str:
+    run = str(tmp_path)
+    rec = TelemetryRecorder(_tdir(run), rank=0)
+    with rec.span("step", step=1):
+        time.sleep(0.001)
+    rec.close()
+    reg = MetricsRegistry(_tdir(run), replica=0, flush_every_n_ticks=1)
+    reg.gauge("queue_depth", 1.0)
+    reg.tick_end()
+    reg.close()
+    fl = FlightRecorder(os.path.join(_tdir(run),
+                                     "replica0.flight.json"),
+                        replica=0, persist_every=1)
+    fl.record("tick", n=1)
+    fl.close()
+    append_incident(run, {"rule": "r", "severity": "warn",
+                          "wall": time.time(), "evidence": {}})
+    return run
+
+
+def test_merged_ordering_and_counts(tmp_path):
+    run = _multi_source_dir(tmp_path)
+    tl = load_timeline_events(run)
+    assert set(tl["sources"]) >= {"spans", "metrics", "flight",
+                                  "incident"}
+    walls = [e.wall for e in tl["events"] if e.aligned]
+    assert walls == sorted(walls)
+    assert tl["garbage_lines"] == 0
+
+
+def test_garbage_lines_skipped_with_count(tmp_path):
+    run = _multi_source_dir(tmp_path)
+    # tear two ledgers mid-line (the kill-mid-append shape)
+    span_file = next(
+        os.path.join(_tdir(run), f)
+        for f in os.listdir(_tdir(run)) if f.endswith(".spans.jsonl"))
+    with open(span_file, "a") as f:
+        f.write('{"phase": "step", "t": 0.5, "du')
+    with open(os.path.join(run, "autoscale.jsonl"), "w") as f:
+        f.write("not json at all\n")
+    tl = load_timeline_events(run)
+    assert tl["garbage_lines"] == 2
+    assert tl["sources"]["spans"] == 1  # the good span still ingests
+
+
+def test_chrome_trace_schema(tmp_path):
+    run = _multi_source_dir(tmp_path)
+    tl = load_timeline_events(run)
+    doc = to_chrome_trace(tl["events"])
+    assert validate_chrome_trace(doc) == []
+    non_meta = [e for e in doc["traceEvents"] if e["ph"] != "M"]
+    assert len({e["cat"] for e in non_meta}) >= 4
+    ts = [e["ts"] for e in non_meta]
+    assert ts == sorted(ts)
+    # span entries are duration slices; instants carry a scope
+    span_evs = [e for e in non_meta if e["cat"] == "spans"]
+    assert span_evs and all(e["ph"] == "X" and e["dur"] > 0
+                            for e in span_evs)
+    assert json.loads(json.dumps(doc))  # JSON-serializable end to end
+
+
+def test_chrome_trace_validator_rejects_garbage():
+    assert validate_chrome_trace({"nope": 1})
+    assert validate_chrome_trace(
+        {"traceEvents": [{"name": "x", "ph": "i"}]})  # no pid/tid/ts
+    assert validate_chrome_trace(
+        {"traceEvents": [{"name": "x", "ph": "X", "pid": 0, "tid": 0,
+                          "ts": 1.0}]})  # duration without dur
+
+
+def test_timeline_excerpt_window(tmp_path):
+    run = _multi_source_dir(tmp_path)
+    tl = load_timeline_events(run)
+    mid = tl["events"][len(tl["events"]) // 2]
+    ex = timeline_excerpt(tl["events"], mid.wall, n=2)
+    assert 1 <= len(ex) <= 5
+    assert all("source" in d and "wall" in d for d in ex)
+
+
+def test_empty_dir_is_partial_not_fatal(tmp_path):
+    tl = load_timeline_events(str(tmp_path))
+    assert tl["events"] == [] and tl["sources"] == {}
+    assert render_text(tl).startswith("timeline:")
+
+
+# ----------------------------------------------------------- CLI surface
+
+
+def test_timeline_cli(tmp_path, capsys):
+    from ray_lightning_tpu.__main__ import main
+
+    run = _multi_source_dir(tmp_path / "run")
+    out = str(tmp_path / "trace.json")
+    assert main(["timeline", run, "--chrome", out]) == 0
+    with open(out) as f:
+        doc = json.load(f)
+    assert validate_chrome_trace(doc) == []
+    capsys.readouterr()
+    assert main(["timeline", run, "--limit", "5"]) == 0
+    text = capsys.readouterr().out
+    assert "timeline:" in text and "spans" in text
+    assert main(["timeline", str(tmp_path / "nope")]) == 2
+
+
+def test_timeline_cli_json_and_source_filter(tmp_path, capsys):
+    from ray_lightning_tpu.__main__ import main
+
+    run = _multi_source_dir(tmp_path / "run")
+    assert main(["timeline", run, "--json", "--source", "spans"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["events"]
+    assert all(e["source"] == "spans" for e in doc["events"])
+
+
+# --------------------------------------- tail-bounded reads (RLT503 seam)
+
+
+def test_ledger_tail_lines_keeps_header(tmp_path):
+    path = str(tmp_path / "x.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"version": "v", "t0_wall": 1.0}) + "\n")
+        for i in range(1000):
+            f.write(json.dumps({"i": i}) + "\n")
+    first, body = ledger_tail_lines(path, tail_bytes=256)
+    assert json.loads(first)["version"] == "v"
+    rows = [json.loads(ln) for ln in body]
+    assert rows and rows[-1]["i"] == 999
+    assert len(rows) < 1000  # actually bounded
+    # partial line at the cut edge is dropped, not mangled
+    assert all("i" in r for r in rows)
+    # unbounded read returns everything
+    _first, full = ledger_tail_lines(path)
+    assert len(full) == 1000
+
+
+def test_read_spans_tail_bounded(tmp_path):
+    run = str(tmp_path)
+    rec = TelemetryRecorder(_tdir(run), rank=0, ring_size=4096)
+    for i in range(500):
+        rec.record("step", 0.0, 0.001, step=i)
+    rec.close()
+    path = rec._path
+    from ray_lightning_tpu.telemetry.spans import read_spans
+
+    full = read_spans(path)
+    assert len(full["spans"]) == 500
+    tail = read_spans(path, tail_bytes=2048)
+    assert tail["header"] == full["header"]  # header survives the cut
+    assert 0 < len(tail["spans"]) < 500
+    assert tail["spans"][-1] == full["spans"][-1]
+
+
+def test_read_metrics_tail_keeps_hists(tmp_path):
+    run = str(tmp_path)
+    reg = MetricsRegistry(_tdir(run), replica=0, flush_every_n_ticks=8)
+    for i in range(200):
+        reg.gauge("queue_depth", float(i))
+        reg.observe("ttft_s", 0.01)
+        reg.tick_end()
+    reg.close()
+    from ray_lightning_tpu.telemetry.metrics import (
+        metrics_paths,
+        read_metrics,
+    )
+
+    path = metrics_paths(_tdir(run))[0]
+    tail = read_metrics(path, tail_bytes=4096)
+    assert tail["header"]["replica"] == 0
+    # the cumulative hists snapshot lives at the end: the tail read
+    # still sees the FULL histogram
+    assert tail["hists"]["ttft_s"].n == 200
+    assert 0 < len(tail["ticks"]) < 200
+    assert tail["gauges"]["queue_depth"] == 199.0
+
+
+# --------------------------- partial run dirs: report/monitor degradation
+
+
+def test_report_empty_dir_degrades_structured(tmp_path, capsys):
+    from ray_lightning_tpu.__main__ import main
+    from ray_lightning_tpu.telemetry.report import build_report
+
+    out = build_report(str(tmp_path))
+    assert out["goodput"] is None and out["step_stats"] is None
+    streams = out["streams"]
+    assert streams["present"] == []
+    assert set(streams["missing"]) >= {"spans", "goodput", "metrics",
+                                       "autoscale", "incidents"}
+    # the CLI renders it without raising and NAMES the missing streams
+    assert main(["report", str(tmp_path)]) == 0
+    text = capsys.readouterr().out
+    assert "missing" in text and "spans" in text
+
+
+def test_report_ledger_subset_degrades(tmp_path, capsys):
+    """A run dir holding ONLY an autoscale ledger (a run killed before
+    the first span flush) must produce a partial report naming what is
+    missing, with the autoscale section intact."""
+    from ray_lightning_tpu.__main__ import main
+    from ray_lightning_tpu.telemetry.report import build_report
+
+    run = str(tmp_path)
+
+    class _Drv:
+        n_live = 2
+        driver_metrics = None
+        driver_flight = None
+
+    from ray_lightning_tpu.autoscale.controller import (
+        AutoscaleController,
+        ControllerConfig,
+    )
+
+    ctl = AutoscaleController(
+        _Drv(), ControllerConfig(), run_dir=run,
+        signal_fn=lambda: {"available": False, "reason": "subset"})
+    ctl.step(now=0.0)
+    out = build_report(run)
+    assert out["streams"]["present"] == ["autoscale"]
+    assert "spans" in out["streams"]["missing"]
+    assert main(["report", run]) == 0
+    assert "autoscale" in capsys.readouterr().out
+
+
+def test_monitor_partial_dirs_do_not_raise(tmp_path):
+    from ray_lightning_tpu.telemetry.report import (
+        _monitor_once,
+        _monitor_serve_once,
+    )
+
+    empty = str(tmp_path / "empty")
+    os.makedirs(empty)
+    view = _monitor_once(empty)
+    assert view["ranks"] == {} and view["goodput"] is None
+    sview = _monitor_serve_once(empty, tail_bytes=4096)
+    assert sview["replicas"] == {}
+    assert sview["load_signal"]["available"] is False
+
+
+def test_report_incidents_section(tmp_path, capsys):
+    from ray_lightning_tpu.__main__ import main
+    from ray_lightning_tpu.telemetry.report import build_report
+
+    run = str(tmp_path)
+    append_incident(run, {
+        "rule": "ttft_p99", "severity": "page", "wall": time.time(),
+        "evidence": {"metric": "serving.ttft_p99_s", "value": 3.0,
+                     "op": ">", "threshold": 2.0},
+        "actions": {"profiler_marker": "m"},
+        "timeline_excerpt": [{"source": "spans"}]})
+    out = build_report(run)
+    inc = out["incidents"]
+    assert inc["count"] == 1
+    assert inc["by_rule"] == {"ttft_p99": 1}
+    assert inc["last"]["evidence"]["value"] == 3.0
+    assert inc["last"]["excerpt_events"] == 1
+    assert "incidents" in out["streams"]["present"]
+    assert main(["report", run]) == 0
+    text = capsys.readouterr().out
+    assert "incidents: 1" in text
