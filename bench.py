@@ -467,11 +467,16 @@ def _serve_summary() -> dict:
 
     ``serve_hbm_bytes_per_replica`` (top-level, EVERY line — ISSUE 11)
     is the flagship replica's static per-device HBM on the attention
-    path the deployment would actually run (the fused paged-attention
-    kernel when it tiles the shape — it retires the reference lane's
-    dense gathered view). bench_gate CEILING-ratchets it: per-replica
-    serving HBM may only shrink; a ``serving_error`` line waives (an
-    analysis bug is not a regression)."""
+    paths the deployment would actually run (the fused paged decode
+    AND prefill kernels when they tile the shape — they retire the
+    reference lanes' dense gathered views). bench_gate
+    CEILING-ratchets it: per-replica serving HBM may only shrink; a
+    ``serving_error`` line waives (an analysis bug is not a
+    regression). ``serve_prefill_gather_bytes`` (top-level, EVERY
+    line — ISSUE 15) is the prefill lane's surviving per-group dense
+    gather on the same plan — 0 once the fused prefill kernel covers
+    the shape; bench_gate CEILING-ratchets it the same way (it may
+    only shrink, anchoring the retirement)."""
     try:
         import jax.numpy as jnp
 
@@ -485,9 +490,10 @@ def _serve_summary() -> dict:
         plan = serve_memory_summary(cfg, ecfg)
         reference = serve_memory_summary(cfg, ecfg, fused=False)
         return {"serving": {
-            "schema": ["decode_tokens_per_s", "ttft_cold_s",
-                       "ttft_warm_s", "ttft_p99_s", "slot_occupancy",
-                       "serving_attention_path", "serve_metrics",
+            "schema": ["decode_tokens_per_s", "prefill_tokens_per_s",
+                       "ttft_cold_s", "ttft_warm_s", "ttft_p99_s",
+                       "slot_occupancy", "serving_attention_path",
+                       "serving_prefill_path", "serve_metrics",
                        "scale_up_s", "autoscale"],
             "autoscale_schema": {
                 "scale_up_s": "wall seconds one controller-driven "
@@ -501,11 +507,15 @@ def _serve_summary() -> dict:
             "source": "static-schema",
             "flagship_plan": plan,
             "attention_path": plan["attention_path"],
+            "prefill_attention_path": plan["prefill_attention_path"],
             "gathered_view_retired_bytes":
                 plan["gathered_view_retired_bytes"],
+            "prefill_kv_traffic_bytes_per_chunk":
+                plan["prefill_kv_traffic_bytes_per_chunk"],
             "reference_hbm_bytes_per_replica":
                 reference["per_device_bytes"],
-        }, "serve_hbm_bytes_per_replica": plan["per_device_bytes"]}
+        }, "serve_hbm_bytes_per_replica": plan["per_device_bytes"],
+           "serve_prefill_gather_bytes": plan["prefill_gather_bytes"]}
     except Exception as exc:  # noqa: BLE001 — advisory data only
         return {"serving_error": f"{type(exc).__name__}: {str(exc)[:200]}"}
 
@@ -583,6 +593,23 @@ def _measure_serving(tiny: bool | None = None,
         sched.tick()
         n_tokens += len(sched.last_emissions)
     wall = _time.perf_counter() - t0
+    # prefill throughput (ISSUE 15): a prefill-DOMINATED drain on the
+    # same warm engine — every request generates one token, so the
+    # wall is the prompt chewing. Tokens counted from the engine's own
+    # prefill_tokens metric (chunk positions actually advanced, incl.
+    # pad columns on the batched lane — the work the kernel did).
+    pf_reg = MetricsRegistry()
+    engine.metrics = pf_reg
+    pf_sched = Scheduler(engine, metrics=pf_reg)
+    for i in range(n_requests):
+        pf_sched.submit(Request(rid=f"p{i}", prompt=prompt[0],
+                                max_new_tokens=1, seed=100 + i))
+    t0 = _time.perf_counter()
+    while pf_sched.busy():
+        pf_sched.tick()
+    pf_wall = _time.perf_counter() - t0
+    pf_tokens = pf_reg.counters().get("prefill_tokens", 0)
+    engine.metrics = reg
     # the serve_metrics rollup: queue-depth stats from the per-tick
     # ring, event counters, and the warm TTFT p99 from the mergeable
     # histogram buckets (the SLO number bench_gate upper-bounds;
@@ -597,15 +624,18 @@ def _measure_serving(tiny: bool | None = None,
     return {
         **autoscale_fields,
         "decode_tokens_per_s": round(n_tokens / max(wall, 1e-9), 2),
+        "prefill_tokens_per_s": round(
+            pf_tokens / max(pf_wall, 1e-9), 2),
         "ttft_cold_s": round(ttft_cold, 4),
         "ttft_warm_s": round(ttft_warm, 4),
         "ttft_p99_s": round(ttft_p99, 4) if ttft_p99 else None,
         "slot_occupancy": round(sched.slot_occupancy, 4),
         "serving_compile_count": engine.compile_count,
-        # which decode attention the measurement actually exercised —
-        # a decode_tokens_per_s number is only comparable to priors on
-        # the same path (ISSUE 11)
+        # which attention each lane actually exercised — a
+        # decode/prefill tok/s number is only comparable to priors on
+        # the same path (ISSUES 11 + 15)
         "serving_attention_path": engine.attention_path,
+        "serving_prefill_path": engine.prefill_path,
         "serve_metrics": {
             "queue_depth_p50": qd[len(qd) // 2] if qd else None,
             "queue_depth_max": qd[-1] if qd else None,

@@ -53,6 +53,7 @@ __all__ = [
     "Topology", "CollectiveCost", "ICI_SPECS", "DCN_SPECS",
     "MXU_EFFICIENCY", "parse_topology", "topology_for_kind",
     "collective_cost", "compute_time_us",
+    "paged_decode_traffic_bytes", "paged_prefill_traffic_bytes",
 ]
 
 #: ICI spec sheet per device family: (device_kind for the HBM table,
@@ -348,3 +349,23 @@ def paged_decode_traffic_bytes(pool_bytes: int, gathered_view_bytes: int,
     if fused:
         return int(pool_bytes)
     return int(pool_bytes + 2 * gathered_view_bytes)
+
+
+def paged_prefill_traffic_bytes(group_view_bytes: int, chunk_bytes: int,
+                                fused: bool) -> int:
+    """Per-chunk HBM *traffic* of the serving PREFILL lane's KV
+    movement (docs/SERVING.md "paged prefill kernel") — the prefill
+    twin of `paged_decode_traffic_bytes`.
+
+    Every chunk must stream the group's already-written blocks once
+    (<= the group's span, read) and write the chunk's new K/V. The
+    reference lane additionally WRITES the dense per-group gathered
+    view and READS it back through the model's chunked cache path —
+    the copy is the traffic. The fused kernel streams the table-named
+    blocks straight through VMEM, so its traffic floor is the group's
+    block reads plus the chunk write. A conservative per-chunk model
+    (the group's full span charged even early in the prompt;
+    Q/output/weight bytes excluded — identical on both paths)."""
+    if fused:
+        return int(group_view_bytes + chunk_bytes)
+    return int(3 * group_view_bytes + chunk_bytes)

@@ -128,8 +128,19 @@ RULES: Dict[str, Rule] = {r.id: r for r in (
          "directly through the block tables and retires the copy "
          "(ops/pallas/paged_attention.py; selected automatically on "
          "TPU — docs/SERVING.md 'paged-attention kernel'). The "
-         "single-slot prefill gather is sanctioned: it is per-slot "
-         "sized and the kernel covers decode only"),
+         "cond-nested prefill gather is RLT308's domain"),
+    Rule("RLT308", "dense-paged-prefill-gather", "warning",
+         "a serving step's PREFILL lane materializes a dense "
+         "group-sized KV view of the block-paged pool ([L, "
+         "prefill_batch, gathered_len, Hkv, hd] per chunk — the last "
+         "dense gather on the serving hot path, a per-chunk copy of "
+         "HBM traffic) although the fused paged-prefill kernel "
+         "supports the shape: the kernel attends causally through the "
+         "block tables with the chunk's K/V scattered straight into "
+         "owned pool blocks, and the gather never exists "
+         "(ops/pallas/paged_prefill.py; selected automatically on TPU "
+         "— docs/SERVING.md 'paged prefill kernel'). Shapes the "
+         "kernel cannot tile keep the historical sanction"),
     Rule("RLT303", "ring-deadlock", "error",
          "a ppermute permutation is not a valid schedule (duplicate "
          "source/destination, out-of-range rank, a full permutation "

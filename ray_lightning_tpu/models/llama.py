@@ -148,6 +148,15 @@ class LlamaConfig:
             hidden_dim=128, max_seq_len=256, remat=False), **kw})
 
 
+def _is_prefill_view(paged) -> bool:
+    """Is this paged view the PREFILL lane's (chunk-wide queries) or
+    the decode lane's (one token per slot)? Import-deferred so the
+    training path never pays the serve-op import."""
+    from ray_lightning_tpu.ops.attention import PagedPrefillView
+
+    return isinstance(paged, PagedPrefillView)
+
+
 def _is_flash_remat_opt(params) -> bool:
     """Is this `remat_opt` equation the flash kernel's hoisted fwd rule?
 
@@ -233,8 +242,13 @@ class LlamaBlock(nn.Module):
         scratch-redirected) write index, and attention consumes the
         pool through the per-slot block tables — fused on the pallas
         path, dense-gathered on the XLA reference path
-        (ops.attention.paged_attention). ``paged=None`` lowers the
-        identical historical program."""
+        (ops.attention.paged_attention). A `PagedPrefillView` instead
+        selects the chunked PREFILL twin: S is the chunk width, ``pos``
+        the group's shared scalar write offset, the whole chunk's K/V
+        is scattered through ``write_block/write_offset`` and
+        `ops.attention.paged_prefill` attends causally through the
+        tables. ``paged=None`` lowers the identical historical
+        program."""
         cfg = self.cfg
         d, hd = cfg.dim, cfg.head_dim
         dense = partial(nn.Dense, use_bias=False, dtype=cfg.dtype,
@@ -290,6 +304,40 @@ class LlamaBlock(nn.Module):
 
                 attn = checkpoint_name(attn, "attn_out")
             new_cache = None
+        elif paged is not None and _is_prefill_view(paged):
+            # paged PREFILL (serve/engine.py fused prefill lane): a
+            # CH-token chunk per head-group row against the SHARED
+            # block pool — the per-group dense cache copy never exists
+            # on this path. ``pos`` is the group's shared scalar write
+            # offset (chunk token j sits at cache position pos + j);
+            # ``pad`` is the per-row left pad of the right-aligned
+            # group (None on the single-slot lane).
+            positions = jnp.broadcast_to(
+                (pos + jnp.arange(S))[None, :], (B, S))
+            if pad is not None:
+                positions = jnp.maximum(positions - pad[:, None], 0)
+            q = apply_rope(q, cos, sin, positions=positions)
+            k = apply_rope(k, cos, sin, positions=positions)
+            pk, pv = cache  # [n_blocks, P, Hkv, hd] — one layer's pool
+            # write-then-attend, the decode fused lane's ordering: the
+            # whole chunk's K/V is scattered into OWNED pool blocks
+            # (vacant group rows arrive scratch-redirected — block 0 is
+            # masked garbage by contract) BEFORE attention, so each
+            # query's causal window covers the in-chunk prefix too.
+            pk = pk.at[paged.write_block, paged.write_offset].set(
+                k.astype(pk.dtype))
+            pv = pv.at[paged.write_block, paged.write_offset].set(
+                v.astype(pv.dtype))
+            from ray_lightning_tpu.ops.attention import paged_prefill
+
+            # the view's STATIC use_pallas (the serve engine's
+            # build-time decision) pins the dispatch; absent that,
+            # fall back to the flash-style ambient policy
+            up = (paged.use_pallas if paged.use_pallas is not None
+                  else (None if cfg.use_flash else False))
+            attn = paged_prefill(q, pk, pv, paged.tables, pos, pad=pad,
+                                 use_pallas=up)
+            new_cache = (pk, pv)
         elif paged is not None:
             # paged decode (serve/engine.py fused lane): one token per
             # slot against the SHARED block pool — no per-slot dense

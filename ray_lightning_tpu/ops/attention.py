@@ -13,9 +13,14 @@ The hot op of the flagship model. Three tiers:
      (ray_lightning_tpu.ops.pallas.paged_attention); the XLA reference
      path gathers a dense per-slot view first (identical semantics —
      that copy is exactly what the kernel retires, docs/SERVING.md).
+  4. `paged_prefill` — the chunked causal twin for the serving
+     engine's prefill lane (ray_lightning_tpu.ops.pallas.paged_prefill):
+     a CH-token query chunk per group row against the same pool, which
+     retires the prefill lane's per-group gathered view the same way.
 (1)/(2) take [B, S, H, D] (batch, seq, heads, head_dim) and support GQA
 by repeating KV heads (XLA turns the repeat into a broadcast, no HBM
-copy); (3) takes one query token per slot, [C, H, D].
+copy); (3) takes one query token per slot, [C, H, D]; (4) takes the
+group's chunk, [B, CH, H, D].
 """
 from __future__ import annotations
 
@@ -184,6 +189,126 @@ def paged_attention_reference(
         mask = mask & (kv_pos >= pad[:, None])
     return dot_product_attention(q[:, None], k, v, causal=False,
                                  mask=mask, scale=scale)[:, 0]
+
+
+@jax.tree_util.register_pytree_node_class
+class PagedPrefillView:
+    """The prefill lane's runtime view of the block-paged KV pool
+    (serve/kv_cache.py layout; one entry per head-group row, all
+    int32):
+
+    ``tables [B, M]`` row -> pool block ids (0 = reserved scratch;
+    vacant group rows carry an all-scratch table);
+    ``write_block/write_offset [B, CH]`` where each of the chunk's CH
+    K/V tokens lands (already scratch-redirected for vacant rows) —
+    the chunk is scattered into OWNED pool blocks before attention
+    runs (write-then-attend, the decode lane's ordering), so the dense
+    per-group gathered view never exists on this path.
+
+    ``use_pallas`` is STATIC pytree aux, not a leaf — the same
+    baked-dispatch discipline as `PagedDecodeView`: it carries the
+    serve engine's build-time decision through `Llama.apply` and the
+    layer scan into `paged_prefill`'s call site, so the compiled
+    attention can never diverge from what
+    `DecodeEngine.prefill_path` reports. None defers to the ambient
+    dispatch policy."""
+
+    def __init__(self, tables, write_block, write_offset,
+                 use_pallas: bool | None = None):
+        self.tables = tables
+        self.write_block = write_block
+        self.write_offset = write_offset
+        self.use_pallas = use_pallas
+
+    def tree_flatten(self):
+        return ((self.tables, self.write_block, self.write_offset),
+                self.use_pallas)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, use_pallas=aux)
+
+
+def paged_prefill_reference(
+    q: jnp.ndarray,
+    pool_k: jnp.ndarray,
+    pool_v: jnp.ndarray,
+    tables: jnp.ndarray,
+    pos,
+    pad: jnp.ndarray | None = None,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """XLA reference with the prefill kernel's exact semantics: gather
+    each row's blocks into a dense [B, M*P, Hkv, hd] view (the copy the
+    pallas kernel exists to retire), mask
+    ``pad[b] <= kv_pos <= pos + j`` (causal against the chunk's cache
+    positions), and run the shared masked-SDPA reference. Scratch-block
+    garbage, table tails and future in-chunk positions are masked to
+    exact softmax zeros; a fully-masked query row (a pad column) emits
+    zeros (the serving numerics contract, docs/SERVING.md)."""
+    b, ch, h, hd = q.shape
+    _, p, hkv, _ = pool_k.shape
+    m = tables.shape[1]
+    k = pool_k[tables].reshape(b, m * p, hkv, hd)
+    v = pool_v[tables].reshape(b, m * p, hkv, hd)
+    kv_pos = jnp.arange(m * p)[None, None, :]
+    q_pos = (pos + jnp.arange(ch))[None, :, None]
+    mask = kv_pos <= q_pos
+    if pad is not None:
+        mask = mask & (kv_pos >= pad[:, None, None])
+    else:
+        mask = jnp.broadcast_to(mask, (b, ch, m * p))
+    return dot_product_attention(q, k, v, causal=False,
+                                 mask=mask[:, None], scale=scale)
+
+
+def paged_prefill_uses_pallas(q_shape, pool_shape,
+                              use_pallas: bool | None = None) -> bool:
+    """Would `paged_prefill` take the pallas kernel for these shapes?
+    ONE predicate shared with the dispatch itself (the
+    `paged_attention_uses_pallas` discipline): the serving engine keys
+    its fused-vs-reference PREFILL lane on this at build time, and the
+    audit/plan legs (`serve/audit.py`) key the per-group gathered-view
+    HBM charge on it — so what is charged can never drift from what
+    runs."""
+    from ray_lightning_tpu.ops import dispatch
+
+    if not dispatch.use_pallas(use_pallas):
+        return False
+    from ray_lightning_tpu.ops.pallas.paged_prefill import (
+        paged_prefill_shapes_supported,
+    )
+
+    return paged_prefill_shapes_supported(q_shape, pool_shape)
+
+
+def paged_prefill(
+    q: jnp.ndarray,
+    pool_k: jnp.ndarray,
+    pool_v: jnp.ndarray,
+    tables: jnp.ndarray,
+    pos,
+    pad: jnp.ndarray | None = None,
+    scale: float | None = None,
+    use_pallas: bool | None = None,
+) -> jnp.ndarray:
+    """Chunked causal prefill attention over the block-paged KV pool:
+    q [B, CH, H, hd], pool [n_blocks, P, Hkv, hd], tables [B, M],
+    pos scalar (chunk token j sits at cache position pos + j) ->
+    [B, CH, H, hd]. Dispatches to the fused pallas kernel when on TPU
+    (or forced, with interpret mode off-TPU) and the shapes tile;
+    otherwise the gathering XLA reference path — identical semantics,
+    but the dense per-group view is materialized (and charged by the
+    serve planner)."""
+    if paged_prefill_uses_pallas(q.shape, pool_k.shape, use_pallas):
+        from ray_lightning_tpu.ops.pallas.paged_prefill import (
+            paged_prefill_pallas,
+        )
+
+        return paged_prefill_pallas(q, pool_k, pool_v, tables, pos,
+                                    pad=pad, scale=scale)
+    return paged_prefill_reference(q, pool_k, pool_v, tables, pos,
+                                   pad=pad, scale=scale)
 
 
 def paged_attention_uses_pallas(q_shape, pool_shape,

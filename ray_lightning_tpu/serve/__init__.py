@@ -13,7 +13,11 @@ Layers:
                     respawn + deterministic replay on replica death
   * audit.py      — tracecheck audit of the decode step + the serving
                     HBM plan leg
-  * cli.py        — ``python -m ray_lightning_tpu serve`` (+ --smoke)
+  * sweep.py      — block-size autotune for BOTH paged kernels
+                    (correctness matrix everywhere, wall-clock on TPU,
+                    JSON artifact -> ``apply_autotune``)
+  * cli.py        — ``python -m ray_lightning_tpu serve``
+                    (+ --smoke, --autotune)
 """
 from ray_lightning_tpu.serve.engine import DecodeEngine, EngineConfig
 from ray_lightning_tpu.serve.kv_cache import (

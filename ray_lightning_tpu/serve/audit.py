@@ -12,11 +12,16 @@ Two consumers:
   * the test/format.sh gates: the decode step must audit CLEAN on BOTH
     attention paths — the paged gather/kernel must never read as an
     implicit reshard (RLT301), the step contains no ring collectives to
-    deadlock (RLT303), and a step that still materializes the dense
+    deadlock (RLT303), a step that still materializes the dense
     slot-gathered view on a shape the fused kernel supports is flagged
     **RLT307 dense-paged-gather** (fires on the reference-path
     flagship trace; absent on the fused path, where the view does not
-    exist; sanctioned on shapes the kernel cannot tile).
+    exist; sanctioned on shapes the kernel cannot tile), and a step
+    whose cond-nested PREFILL lane still gathers its group-sized pool
+    view on a shape the fused prefill kernel tiles is flagged
+    **RLT308 dense-paged-prefill-gather** (same fire/sanction
+    discipline — the historical blanket sanction of the prefill
+    gather became shape-conditional once the kernel covered it).
 """
 from __future__ import annotations
 
@@ -30,11 +35,11 @@ from ray_lightning_tpu.serve.kv_cache import serve_kv_plan_bytes
 
 
 def _shape_fused_available(model_cfg, engine_cfg: EngineConfig) -> bool:
-    """Would the fused kernel tile this (model, engine) shape on a TPU?
-    The PLANNER'S question — shape support only, independent of the
-    host's backend (a CPU host planning a v5p deployment must price the
-    kernel the TPU will run; the runtime dispatch adds the backend gate
-    via `ops.attention.paged_attention_uses_pallas`)."""
+    """Would the fused DECODE kernel tile this (model, engine) shape on
+    a TPU? The PLANNER'S question — shape support only, independent of
+    the host's backend (a CPU host planning a v5p deployment must price
+    the kernel the TPU will run; the runtime dispatch adds the backend
+    gate via `ops.attention.paged_attention_uses_pallas`)."""
     from ray_lightning_tpu.ops.pallas.paged_attention import (
         paged_shapes_supported,
     )
@@ -46,8 +51,27 @@ def _shape_fused_available(model_cfg, engine_cfg: EngineConfig) -> bool:
          model_cfg.head_dim))
 
 
+def _shape_fused_prefill_available(model_cfg,
+                                   engine_cfg: EngineConfig) -> bool:
+    """The prefill twin of `_shape_fused_available`: would the fused
+    PREFILL kernel tile this (model, engine) shape on a TPU? The two
+    kernels gate shapes independently (the prefill kernel additionally
+    tiles the chunk width)."""
+    from ray_lightning_tpu.ops.pallas.paged_prefill import (
+        paged_prefill_shapes_supported,
+    )
+
+    spec = engine_cfg.pool_spec
+    return paged_prefill_shapes_supported(
+        (engine_cfg.prefill_batch, engine_cfg.prefill_chunk,
+         model_cfg.n_heads, model_cfg.head_dim),
+        (spec.n_blocks, spec.block_size, model_cfg.n_kv_heads,
+         model_cfg.head_dim))
+
+
 def trace_decode_step(model_cfg, engine_cfg: EngineConfig,
-                      fused: bool = False):
+                      fused: bool = False,
+                      fused_prefill: Optional[bool] = None):
     """``(closed_jaxpr, meta)`` for the engine's continuous-batching
     step over abstract inputs — the exact program `DecodeEngine` jits,
     traced with `eval_shape`/`make_jaxpr` so no backend initializes.
@@ -57,18 +81,31 @@ def trace_decode_step(model_cfg, engine_cfg: EngineConfig,
     (`PagedDecodeView.use_pallas`, the same static aux `DecodeEngine`
     compiles), so the audited program IS the one a fused replica runs
     regardless of the host's backend; ``fused=False`` traces the
-    reference lane as dispatched on this host. ``meta`` carries
-    ``pallas_kernels`` (kernel identities found anywhere in the trace)
-    and ``dense_paged_gathers`` (top-level capacity-wide gathers of
-    the pool — the RLT307 evidence)."""
+    reference lane as dispatched on this host. ``fused_prefill``
+    selects the prefill lane the same way; ``None`` (the default)
+    follows ``fused`` GATED BY the prefill kernel's own shape support
+    — the engine decides the two lanes independently
+    (`DecodeEngine.fused_prefill`), so on a shape only the decode
+    kernel tiles the default traces the mixed program the replica
+    actually compiles, not a fused-prefill program that would silently
+    fall back inside the trace. ``meta`` carries ``pallas_kernels``
+    (kernel identities found anywhere in the trace),
+    ``dense_paged_gathers`` (top-level capacity-wide gathers of the
+    pool — the RLT307 evidence) and ``prefill_paged_gathers``
+    (cond-nested group-sized gathers of the pool — the RLT308
+    evidence)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from ray_lightning_tpu.models.llama import Llama
 
+    if fused_prefill is None:
+        fused_prefill = fused and _shape_fused_prefill_available(
+            model_cfg, engine_cfg)
     model = Llama(model_cfg)
-    step = build_step(model, engine_cfg, fused=fused)
+    step = build_step(model, engine_cfg, fused=fused,
+                      fused_prefill=fused_prefill)
     spec = engine_cfg.pool_spec
     C, CH, B = engine_cfg.capacity, engine_cfg.prefill_chunk, \
         engine_cfg.prefill_batch
@@ -114,9 +151,13 @@ def trace_decode_step(model_cfg, engine_cfg: EngineConfig,
         "args": args,
         "params_bytes": params_bytes,
         "fused": fused,
+        "fused_prefill": fused_prefill,
         "pallas_kernels": _pallas_kernel_names(closed.jaxpr),
         "dense_paged_gathers": _dense_paged_gathers(
             closed.jaxpr, pool_shape, C),
+        "prefill_paged_gathers": _prefill_paged_gathers(
+            closed.jaxpr, pool_shape, C,
+            engine_cfg.pool_spec.blocks_per_slot),
     }
 
 
@@ -150,9 +191,10 @@ def _dense_paged_gathers(jaxpr, pool_shape, capacity: int) -> list:
     capacity-wide dense slot view ``[L, C, M, P, Hkv, hd]`` — the
     decode lane's materialized copy, and RLT307's evidence. Top level
     only by design: the prefill lane's per-group gather lives inside
-    the step's `lax.cond` and is sanctioned (the kernel covers decode;
-    the prefill copy is group-sized, priced honestly by
-    `serve_kv_plan_bytes`)."""
+    the step's `lax.cond` and is RLT308's domain
+    (`_prefill_paged_gathers` — shape-conditional on the fused PREFILL
+    kernel covering it, no longer a blanket sanction; the copy is
+    group-sized, priced honestly by `serve_kv_plan_bytes`)."""
     pool_vars = [v for v in jaxpr.invars
                  if tuple(getattr(v.aval, "shape", ())) == pool_shape]
     hits = []
@@ -168,20 +210,85 @@ def _dense_paged_gathers(jaxpr, pool_shape, capacity: int) -> list:
     return hits
 
 
+def _prefill_paged_gathers(jaxpr, pool_shape, capacity: int,
+                           blocks_per_slot: int) -> list:
+    """Gathers of a pool-shaped operand at ANY nesting level whose
+    output is a group-sized dense slot view — the prefill lane's
+    materialized per-group copy (it lives inside the step's `lax.cond`)
+    and RLT308's evidence. Two shapes qualify:
+
+      * ``[L, B, M, P, Hkv, hd]`` with ``B <= capacity`` and
+        ``M == blocks_per_slot`` — the batched lane's group view
+        (the capacity-wide B == capacity decode view is RLT307's
+        top-level evidence, but nested it is still a dense paged
+        gather and counts here);
+      * ``[L, M, P, Hkv, hd]`` with ``M == blocks_per_slot`` — the
+        single-slot lane's per-row view.
+
+    Matching is by aval shape (a cond/pjit branch's pool invar carries
+    the pool's aval), the same discipline as `_dense_paged_gathers`."""
+    L, _, P, HKV, HD = pool_shape
+    hits = []
+
+    def _match(out_shape) -> bool:
+        if len(out_shape) == 6:
+            return (out_shape[0] == L and out_shape[1] <= capacity
+                    and out_shape[2] == blocks_per_slot
+                    and out_shape[3:] == (P, HKV, HD))
+        if len(out_shape) == 5:
+            return (out_shape[0] == L
+                    and out_shape[1] == blocks_per_slot
+                    and out_shape[2:] == (P, HKV, HD))
+        return False
+
+    def _walk(j, nested):
+        for eqn in j.eqns:
+            if (nested and eqn.primitive.name == "gather"
+                    and eqn.invars
+                    and tuple(getattr(eqn.invars[0].aval, "shape", ()))
+                    == pool_shape):
+                out_shape = tuple(getattr(eqn.outvars[0].aval,
+                                          "shape", ()))
+                if _match(out_shape):
+                    hits.append(out_shape)
+            for v in eqn.params.values():
+                vals = v if isinstance(v, (list, tuple)) else (v,)
+                for x in vals:
+                    inner = getattr(x, "jaxpr", None)
+                    if inner is not None and hasattr(inner, "eqns"):
+                        _walk(inner, True)
+
+    _walk(jaxpr, False)
+    return hits
+
+
 def audit_decode_step(model_cfg, engine_cfg: EngineConfig,
                       topology="v5p-8", reserve_fraction: float = 0.10,
                       label: str = "serve decode step",
-                      fused: bool = False):
+                      fused: bool = False,
+                      fused_prefill: Optional[bool] = None,
+                      traced=None):
     """Full tracecheck walk of the decode step: collective schedule
     (none expected on a single-replica step — each replica is one model
-    copy), RLT301/303/307 findings, and the liveness HBM peak vs the
-    chip budget. Returns a `tracecheck.TraceReport`.
+    copy), RLT301/303/307/308 findings, and the liveness HBM peak vs
+    the chip budget. Returns a `tracecheck.TraceReport`.
 
     RLT307 (dense-paged-gather) fires when the traced step materializes
-    the capacity-wide dense KV view although the fused kernel tiles the
-    shape — i.e. on the reference-path flagship trace. The fused trace
-    has no such gather (the view never exists), and shapes the kernel
-    cannot tile are sanctioned."""
+    the capacity-wide dense KV view although the fused decode kernel
+    tiles the shape — i.e. on the reference-path flagship trace. RLT308
+    (dense-paged-prefill-gather) is the prefill twin: it fires when the
+    cond-nested prefill lane still gathers its group-sized pool view
+    although the fused PREFILL kernel tiles the shape (the historical
+    blanket sanction of the prefill gather became shape-conditional
+    once the kernel covered it). The fused trace has neither gather
+    (the views never exist), and shapes the kernels cannot tile are
+    sanctioned.
+
+    ``traced`` takes a ``(closed, meta)`` pair from an earlier
+    `trace_decode_step` call with the SAME config/lanes so a caller
+    that already holds the trace (the smoke legs read meta's gather
+    evidence directly) never pays a second full trace of the same
+    step — the PR 11 one-trace discipline."""
     from ray_lightning_tpu.analysis.findings import Finding
     from ray_lightning_tpu.analysis.tracecheck import (
         TraceReport, _repl, _StepAuditor, _VarInfo, classify_overlap,
@@ -189,7 +296,10 @@ def audit_decode_step(model_cfg, engine_cfg: EngineConfig,
 
     topo = (topology if isinstance(topology, Topology)
             else parse_topology(topology))
-    closed, meta = trace_decode_step(model_cfg, engine_cfg, fused=fused)
+    closed, meta = (traced if traced is not None
+                    else trace_decode_step(model_cfg, engine_cfg,
+                                           fused=fused,
+                                           fused_prefill=fused_prefill))
     auditor = _StepAuditor({}, topo, {})
     jaxpr = closed.jaxpr
     env = {}
@@ -208,24 +318,43 @@ def audit_decode_step(model_cfg, engine_cfg: EngineConfig,
             "serving step will OOM on this chip — shrink capacity, "
             "blocks_per_slot, or the pool",
             symbol=label))
+    import math
+
+    import numpy as np
+
+    def _view_gib(shape) -> float:
+        # k + v gathers at the POOL's dtype (model_cfg.dtype — the
+        # first step invar is a param leaf whose dtype can differ,
+        # e.g. f32 params serving a bf16 cache)
+        return (2 * math.prod(shape)
+                * np.dtype(model_cfg.dtype).itemsize) / gib
+
     if meta["dense_paged_gathers"] and _shape_fused_available(
             model_cfg, engine_cfg):
         shape = meta["dense_paged_gathers"][0]
-        import math
-
-        view_bytes = (2 * math.prod(shape)
-                      * closed.jaxpr.invars[0].aval.dtype.itemsize
-                      if hasattr(closed.jaxpr.invars[0].aval, "dtype")
-                      else 0)
         findings.append(Finding(
             "RLT307",
             f"the decode lane gathers a dense {list(shape)} slot view "
-            f"of the paged pool every tick (~{view_bytes / gib:.2f} "
+            f"of the paged pool every tick (~{_view_gib(shape):.2f} "
             "GiB of HBM + a full copy of traffic) on a shape the fused "
             "paged-attention kernel tiles — the kernel consumes the "
             "pool through the block tables and retires the view "
             "(selected automatically on TPU; "
             "docs/SERVING.md 'paged-attention kernel')",
+            symbol=label))
+    if meta["prefill_paged_gathers"] and _shape_fused_prefill_available(
+            model_cfg, engine_cfg):
+        shape = meta["prefill_paged_gathers"][0]
+        findings.append(Finding(
+            "RLT308",
+            f"the prefill lane gathers a dense {list(shape)} "
+            "group-sized view of the paged pool every chunk "
+            f"(~{_view_gib(shape):.2f} GiB of HBM + a per-chunk copy "
+            "of traffic) on a shape the fused paged-prefill kernel "
+            "tiles — the kernel attends causally through the block "
+            "tables and retires the last dense gather (selected "
+            "automatically on TPU; docs/SERVING.md 'paged prefill "
+            "kernel')",
             symbol=label))
     overlap = classify_overlap(auditor.events, auditor.scopes, topo,
                                scheduled=auditor.saw_prefetch_marker)
@@ -247,24 +376,34 @@ def audit_decode_step(model_cfg, engine_cfg: EngineConfig,
 def serve_memory_summary(model_cfg, engine_cfg: EngineConfig,
                          device_kind: str = "TPU v5p",
                          hbm_bytes: Optional[int] = None,
-                         fused: Optional[bool] = None) -> dict:
+                         fused: Optional[bool] = None,
+                         fused_prefill: Optional[bool] = None) -> dict:
     """The serve-aware plan leg: itemized replica HBM (no optimizer —
-    serving holds weights, the paged pool, the attention path's
-    gathered view, and the carried logits) with a fits verdict against
-    the chip budget. Pure byte math + one eval_shape; no devices.
+    serving holds weights, the paged pool, the attention paths'
+    surviving gathered view, and the carried logits) with a fits
+    verdict against the chip budget. Pure byte math + one eval_shape;
+    no devices.
 
-    ``fused=None`` auto-selects by SHAPE support (the planner prices
-    the path the TPU deployment will run — `_shape_fused_available`);
+    ``fused=None`` / ``fused_prefill=None`` auto-select by SHAPE
+    support (the planner prices the paths the TPU deployment will run
+    — `_shape_fused_available` / `_shape_fused_prefill_available`);
     pass False/True to price a specific path (the before/after table
-    in docs/SERVING.md is exactly this pair)."""
+    in docs/SERVING.md is exactly these pairs)."""
     import jax
     import numpy as np
 
+    from ray_lightning_tpu.analysis.costmodel import (
+        paged_prefill_traffic_bytes,
+    )
     from ray_lightning_tpu.models.llama import Llama
     from ray_lightning_tpu.parallel.plan import hbm_bytes_for_kind
+    from ray_lightning_tpu.serve.kv_cache import gathered_view_bytes
 
     if fused is None:
         fused = _shape_fused_available(model_cfg, engine_cfg)
+    if fused_prefill is None:
+        fused_prefill = _shape_fused_prefill_available(model_cfg,
+                                                       engine_cfg)
     model = Llama(model_cfg)
     a_params = jax.eval_shape(
         lambda k: model.init(k, np.zeros((1, 2), np.int32))["params"],
@@ -275,24 +414,43 @@ def serve_memory_summary(model_cfg, engine_cfg: EngineConfig,
     spec = engine_cfg.pool_spec
     kv = serve_kv_plan_bytes(model_cfg, spec, engine_cfg.capacity,
                              fused=fused,
-                             prefill_batch=engine_cfg.prefill_batch)
+                             prefill_batch=engine_cfg.prefill_batch,
+                             fused_prefill=fused_prefill)
     budget = hbm_bytes if hbm_bytes is not None else \
         hbm_bytes_for_kind(device_kind)
     usable = int(budget * 0.90)
-    # the retired term is REPORTING (what the kernel bought back), not
-    # a resident buffer — it must never inflate the fits verdict
+    # the retired term is REPORTING (what the kernels bought back) and
+    # prefill_gather_bytes is an ITEMIZATION of the surviving view (a
+    # slice of gathered_view_bytes, never an extra buffer) — neither
+    # may inflate the fits verdict
     resident = {k: v for k, v in kv.items()
-                if k != "gathered_view_retired_bytes"}
+                if k not in ("gathered_view_retired_bytes",
+                             "prefill_gather_bytes")}
     total = params_bytes + sum(resident.values())
+    # per-chunk prefill traffic: the group's span (block reads) + the
+    # chunk's new K/V write, with the reference lane's view write+read
+    # on top (costmodel.paged_prefill_traffic_bytes)
+    group_span = int(gathered_view_bytes(
+        model_cfg, spec, min(engine_cfg.prefill_batch,
+                             engine_cfg.capacity)))
+    itemsize = np.dtype(model_cfg.dtype).itemsize
+    chunk_bytes = (2 * model_cfg.n_layers * engine_cfg.prefill_batch
+                   * engine_cfg.prefill_chunk * model_cfg.n_kv_heads
+                   * model_cfg.head_dim * itemsize)
     return {
         "params_bytes": int(params_bytes),
         **kv,
         "attention_path": ("paged-pallas" if fused
                            else "reference-gather"),
+        "prefill_attention_path": ("paged-pallas" if fused_prefill
+                                   else "reference-gather"),
         "decode_kv_traffic_bytes_per_tick": paged_decode_traffic_bytes(
             kv["pool_bytes"], serve_kv_plan_bytes(
                 model_cfg, spec, engine_cfg.capacity,
                 fused=False)["gathered_view_bytes"], fused),
+        "prefill_kv_traffic_bytes_per_chunk":
+            paged_prefill_traffic_bytes(group_span, chunk_bytes,
+                                        fused_prefill),
         "capacity": engine_cfg.capacity,
         "block_size": spec.block_size,
         "n_blocks": spec.n_blocks,
@@ -306,30 +464,48 @@ def serve_memory_summary(model_cfg, engine_cfg: EngineConfig,
 def format_serve_summary(s: dict) -> str:
     gib = 1024**3
     fused = s.get("attention_path") == "paged-pallas"
-    if fused:
+    fused_pf = s.get("prefill_attention_path") == "paged-pallas"
+    if fused and fused_pf:
+        view_line = (
+            f"  gathered view    {s['gathered_view_bytes'] / gib:7.2f} "
+            "GiB  (prefill gather itemized at "
+            f"{s.get('prefill_gather_bytes', 0) / gib:.2f} GiB; the "
+            f"{s['gathered_view_retired_bytes'] / gib:.2f} GiB dense "
+            "views are RETIRED by the fused paged decode + prefill "
+            "kernels — no dense gather remains)")
+    elif fused:
         view_line = (
             f"  prefill gather   {s['gathered_view_bytes'] / gib:7.2f} "
             "GiB  (per-group prefill copy; the decode lane's "
             f"{s['gathered_view_retired_bytes'] / gib:.2f} GiB dense "
-            "view is RETIRED by the fused paged-attention kernel)")
+            "view is RETIRED by the fused paged-attention kernel, and "
+            "the fused paged-prefill kernel retires this remainder)")
     else:
         view_line = (
             f"  gathered view    {s['gathered_view_bytes'] / gib:7.2f} "
-            "GiB  (reference engine's dense copy; the fused "
-            "paged-attention kernel retires it)")
+            "GiB  (reference engine's dense copy; the fused paged "
+            "decode + prefill kernels retire it)")
+    traffic_tail = ")" if fused else " + dense-view write+read)"
+    pf_traffic = s.get("prefill_kv_traffic_bytes_per_chunk")
     lines = [
         f"serve plan: {s['capacity']} slots x {s['max_slot_len']} "
         f"tokens, pool {s['n_blocks']} x {s['block_size']}-token "
-        f"blocks, attention path: {s.get('attention_path', '?')}",
+        f"blocks, attention path: {s.get('attention_path', '?')}, "
+        f"prefill path: {s.get('prefill_attention_path', '?')}",
         f"  params           {s['params_bytes'] / gib:7.2f} GiB",
         f"  kv pool          {s['pool_bytes'] / gib:7.2f} GiB",
         view_line,
         f"  carried logits   {s['last_logits_bytes'] / gib:7.2f} GiB",
         f"  decode KV traffic {s['decode_kv_traffic_bytes_per_tick'] / gib:6.2f}"
-        " GiB/tick (cost model: pool read"
-        + (")" if fused else " + dense-view write+read)"),
+        " GiB/tick (cost model: pool read" + traffic_tail,
+    ]
+    if pf_traffic is not None:
+        lines.append(
+            f"  prefill KV traffic {pf_traffic / gib:5.2f} GiB/chunk "
+            "(cost model: group-block reads + chunk write"
+            + (")" if fused_pf else " + group-view write+read)"))
+    lines.append(
         f"  total {s['per_device_bytes'] / gib:.2f} GiB vs budget "
         f"{s['budget_bytes'] / gib:.2f} GiB — "
-        f"{'fits' if s['fits'] else 'DOES NOT FIT'}",
-    ]
+        f"{'fits' if s['fits'] else 'DOES NOT FIT'}")
     return "\n".join(lines)

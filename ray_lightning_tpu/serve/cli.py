@@ -34,7 +34,14 @@ runs; it fails (exit 1) unless ALL of:
     kernel-tiling tiny config): 8 concurrent streams match the
     reference-path engine token for token, churn still compiles once,
     the fused decode step audits clean with RLT307 absent and the
-    paged-attention kernel actually present in the trace.
+    paged-attention kernel actually present in the trace;
+  * the FUSED paged-PREFILL path (ISSUE 15, same kernel-tiling tiny
+    discipline): a ragged left-padded prefill group (prefill_batch=2,
+    a chunk width that does not divide the slot length) decodes
+    token-for-token equal to the reference-lane engine, churn compiles
+    once, and the fused step audits clean with ZERO dense paged
+    gathers at ANY nesting level (RLT307 + RLT308 absent, the
+    paged-prefill kernel present in the trace).
 """
 from __future__ import annotations
 
@@ -79,6 +86,13 @@ def add_serve_parser(sub) -> None:
                    help="telemetry spans + serving.json land here")
     p.add_argument("--topo", default="v5p-8",
                    help="topology for the decode-step audit")
+    p.add_argument("--autotune", metavar="OUT.json", default=None,
+                   help="run the block-size sweep for BOTH paged "
+                        "kernels on this preset's shape and write the "
+                        "winning geometry artifact (serve/sweep.py; "
+                        "interpret-mode correctness everywhere, "
+                        "wall-clock timing on a real TPU backend, "
+                        "structured skip otherwise)")
     p.add_argument("--json", action="store_true", dest="as_json",
                    default=argparse.SUPPRESS)
 
@@ -139,7 +153,8 @@ def _check_outputs(outputs, refs) -> list:
 
 
 def run_smoke(args) -> int:
-    """The format.sh gate. Three legs, all CPU."""
+    """The format.sh gate (module docstring for the leg list), all
+    CPU."""
     from ray_lightning_tpu.serve.audit import audit_decode_step
     from ray_lightning_tpu.serve.driver import (
         ReplicaGroupConfig, ServeDriver, save_params_npz,
@@ -230,6 +245,10 @@ def run_smoke(args) -> int:
     # ---- leg 4: fused paged-attention path ----------------------------
     verdict["legs"]["fused_paged"] = _smoke_fused_leg(failures,
                                                      args.topo)
+
+    # ---- leg 5: fused paged-PREFILL path ------------------------------
+    verdict["legs"]["fused_prefill"] = _smoke_fused_prefill_leg(
+        failures, args.topo)
 
     verdict["ok"] = not failures
     if failures:
@@ -373,46 +392,48 @@ def _smoke_flight(failures: list, run_dir: str) -> dict:
     return leg
 
 
-def _smoke_fused_leg(failures: list, topo: str) -> dict:
-    """The fused-path smoke leg: the paged-attention kernel (interpret
-    mode under `force_pallas`) must serve 8 concurrent streams token-
-    for-token equal to the reference-path engine, compile once across
-    churn, and audit clean (RLT307 absent — the dense view is gone).
-
-    Runs on its own kernel-TILING tiny config (head_dim 64, GQA 2:1,
-    8-token blocks): the main legs' tiny model has head_dim 16, which
-    the kernel correctly refuses (`paged_shapes_supported`) — dispatch
-    honesty is part of what this leg proves."""
+def _fused_leg_harness(ecfg, *, prompt_key: int, param_key: int,
+                       rid_prefix: str, temp: float, top_k: int,
+                       seed_base: int, prompt_floor: int,
+                       prompt_mod: int):
+    """Shared harness of the two fused smoke legs: the kernel-TILING
+    tiny model (head_dim 64, GQA 2:1 — the main legs' tiny model has
+    head_dim 16, which both kernels correctly refuse; dispatch honesty
+    is part of what the legs prove), a ragged mixed-sampling request
+    set, one reference-lane run, one force_pallas run. Returns
+    ``(cfg, eng, out_ref, out_fused, mismatched)`` — the legs keep
+    their own audit verdicts, but the run discipline (reserve policy,
+    churn shape, stream comparison) cannot drift between them."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from ray_lightning_tpu.models.llama import Llama, LlamaConfig
     from ray_lightning_tpu.ops import dispatch
-    from ray_lightning_tpu.serve.audit import audit_decode_step
-    from ray_lightning_tpu.serve.engine import DecodeEngine, EngineConfig
+    from ray_lightning_tpu.serve.engine import DecodeEngine
     from ray_lightning_tpu.serve.scheduler import Request, Scheduler
 
     cfg = LlamaConfig(vocab_size=256, dim=128, n_layers=2, n_heads=2,
                       n_kv_heads=1, hidden_dim=256, max_seq_len=128,
                       remat=False, dtype=jnp.float32)
-    ecfg = EngineConfig(capacity=4, block_size=8, blocks_per_slot=4,
-                        prefill_chunk=4, prefill_batch=2)
     model = Llama(cfg)
     prompts = [
         np.array(jax.random.randint(
-            jax.random.key(300 + i), (3 + (i % 5),), 0,
+            jax.random.key(prompt_key + i),
+            (prompt_floor + (i % prompt_mod),), 0,
             cfg.vocab_size), dtype=np.int32)
         for i in range(8)
     ]
-    params = jax.jit(model.init)(jax.random.key(7),
+    params = jax.jit(model.init)(jax.random.key(param_key),
                                  prompts[0][None])["params"]
 
     def run(engine):
         sched = Scheduler(engine, reserve="on_demand")
-        pend = [Request(rid=f"f{i}", prompt=p, max_new_tokens=8,
-                        temperature=0.8 if i % 2 else 0.0,
-                        top_k=5 if i % 2 else None, seed=61 + i)
+        pend = [Request(rid=f"{rid_prefix}{i}", prompt=p,
+                        max_new_tokens=8,
+                        temperature=temp if i % 2 else 0.0,
+                        top_k=top_k if i % 2 else None,
+                        seed=seed_base + i)
                 for i, p in enumerate(prompts)]
         out = {}
         while sched.busy() or pend:
@@ -426,15 +447,32 @@ def _smoke_fused_leg(failures: list, topo: str) -> dict:
     out_ref = run(ref_engine)
     with dispatch.force_pallas():
         eng = DecodeEngine(model, params, ecfg)
-        fused_selected = eng.fused
-        out_fused = run(eng) if fused_selected else {}
+        out_fused = run(eng) if (eng.fused or eng.fused_prefill) \
+            else {}
+    mismatched = [rid for rid in out_ref
+                  if out_fused.get(rid) != out_ref[rid]]
+    return cfg, eng, out_ref, out_fused, mismatched
+
+
+def _smoke_fused_leg(failures: list, topo: str) -> dict:
+    """The fused-path smoke leg: the paged-attention kernel (interpret
+    mode under `force_pallas`) must serve 8 concurrent streams token-
+    for-token equal to the reference-path engine, compile once across
+    churn, and audit clean (RLT307 absent — the dense view is gone)."""
+    from ray_lightning_tpu.serve.audit import audit_decode_step
+    from ray_lightning_tpu.serve.engine import EngineConfig
+
+    ecfg = EngineConfig(capacity=4, block_size=8, blocks_per_slot=4,
+                        prefill_chunk=4, prefill_batch=2)
+    cfg, eng, out_ref, out_fused, mismatched = _fused_leg_harness(
+        ecfg, prompt_key=300, param_key=7, rid_prefix="f", temp=0.8,
+        top_k=5, seed_base=61, prompt_floor=3, prompt_mod=5)
+    fused_selected = eng.fused
     # ONE trace serves both verdicts: the audit's findings (RLT307
     # absent here <=> no dense decode gather, since the shape tiles)
     # and the kernel fingerprint the auditor recorded walking it
     report = audit_decode_step(cfg, ecfg, topology=topo, fused=True,
                                label="fused smoke decode step")
-    mismatched = [rid for rid in out_ref
-                  if out_fused.get(rid) != out_ref[rid]]
     rules = sorted({f.rule for f in report.findings})
     kernel_in_trace = any("paged_attention" in k
                           for k in report.pallas_kernels)
@@ -464,6 +502,81 @@ def _smoke_fused_leg(failures: list, topo: str) -> dict:
         failures.append("the paged-attention kernel is absent from the "
                         "fused trace — the fused lane fell back to the "
                         "gathering reference op")
+    return leg
+
+
+def _smoke_fused_prefill_leg(failures: list, topo: str) -> dict:
+    """The fused-PREFILL smoke leg (ISSUE 15): on the kernel-tiling
+    tiny config, a RAGGED left-padded prefill group (prefill_batch=2
+    over prompts of assorted lengths, with a chunk width that does not
+    divide the slot length — the PR 8 tail-window class rides along)
+    must decode token-for-token equal to the reference-lane engine,
+    churn must compile once, and the fused step must audit clean with
+    ZERO dense paged gathers at ANY nesting level — both the decode
+    lane's capacity-wide view and the prefill lane's cond-nested
+    group view are gone (`trace_decode_step` meta is the evidence;
+    RLT307/RLT308 absent is the rule-level restatement)."""
+    from ray_lightning_tpu.serve.audit import (
+        audit_decode_step, trace_decode_step,
+    )
+    from ray_lightning_tpu.serve.engine import EngineConfig
+
+    # chunk 12 does not divide the 32-token slot (the scheduler's
+    # slid-back tail window is exercised on the fused lane too) while
+    # still tiling (12 q rows x 2 heads = 24, sublane-aligned; chunk 6
+    # would be refused by `paged_prefill_shapes_supported`)
+    ecfg = EngineConfig(capacity=4, block_size=8, blocks_per_slot=4,
+                        prefill_chunk=12, prefill_batch=2)
+    cfg, eng, out_ref, out_fused, mismatched = _fused_leg_harness(
+        ecfg, prompt_key=500, param_key=9, rid_prefix="pf", temp=0.6,
+        top_k=4, seed_base=91, prompt_floor=2, prompt_mod=7)
+    prefill_selected = eng.fused_prefill
+    # ONE trace serves all three verdicts: the gather evidence in its
+    # meta, the kernel fingerprint, and the audit (fed the same pair
+    # via `traced=` — never a second full trace of the same step)
+    traced = trace_decode_step(cfg, ecfg, fused=True)
+    report = audit_decode_step(cfg, ecfg, topology=topo, fused=True,
+                               label="fused smoke prefill step",
+                               traced=traced)
+    meta = traced[1]
+    rules = sorted({f.rule for f in report.findings})
+    kernel_in_trace = any("paged_prefill" in k
+                          for k in meta["pallas_kernels"])
+    leg = {
+        "prefill_selected": prefill_selected,
+        "stream_mismatches": mismatched,
+        "compile_count": eng.compile_count,
+        "audit_findings": rules,
+        "prefill_kernel_in_trace": kernel_in_trace,
+        "dense_paged_gathers": len(meta["dense_paged_gathers"]),
+        "prefill_paged_gathers": len(meta["prefill_paged_gathers"]),
+        "prefill_path": eng.prefill_path,
+    }
+    if not prefill_selected:
+        failures.append("force_pallas did not select the fused paged-"
+                        "prefill path for a kernel-tiling shape")
+        return leg
+    if mismatched:
+        failures.append(
+            f"fused-prefill streams diverge from the reference path: "
+            f"{mismatched}")
+    if eng.compile_count not in (1, -1):
+        failures.append(
+            f"fused-prefill churn recompiled the step: compile_count="
+            f"{eng.compile_count} (want 1)")
+    if any(r in ("RLT301", "RLT303", "RLT307", "RLT308")
+           for r in rules):
+        failures.append(f"fused prefill step audit findings: {rules}")
+    if meta["dense_paged_gathers"] or meta["prefill_paged_gathers"]:
+        failures.append(
+            f"the fused step still materializes a dense paged gather "
+            f"(top-level {len(meta['dense_paged_gathers'])}, nested "
+            f"{len(meta['prefill_paged_gathers'])}) — the kernels did "
+            f"not retire the views")
+    if not kernel_in_trace:
+        failures.append("the paged-prefill kernel is absent from the "
+                        "fused trace — the prefill lane fell back to "
+                        "the gathering reference op")
     return leg
 
 
@@ -569,9 +682,75 @@ def _run_flagship(args) -> int:
     return 1 if bad else 0
 
 
+def _run_autotune(args) -> int:
+    """``serve <preset> --autotune out.json``: sweep block_size /
+    blocks_per_slot for BOTH paged kernels on the preset's shape and
+    write the artifact `sweep.apply_autotune` consumes
+    (docs/SERVING.md "block-size autotune")."""
+    from ray_lightning_tpu.serve.engine import EngineConfig
+    from ray_lightning_tpu.serve.sweep import (
+        save_artifact, sweep_paged_kernels,
+    )
+
+    if args.preset == "llama3-8b":
+        import jax.numpy as jnp
+
+        from ray_lightning_tpu.models.llama import LlamaConfig
+
+        cfg = LlamaConfig.llama3_8b(max_seq_len=args.seq_budget,
+                                    dtype=jnp.bfloat16)
+        bps = args.blocks_per_slot or -(-args.seq_budget
+                                        // args.block_size)
+        ecfg = EngineConfig(capacity=args.slots,
+                            block_size=args.block_size,
+                            blocks_per_slot=bps,
+                            prefill_chunk=max(args.prefill_chunk, 128),
+                            prefill_batch=args.prefill_batch)
+    else:
+        # the demo sweeps a KERNEL-TILING tiny shape (head_dim 64, GQA
+        # 2:1 — the fused smoke leg's config): the main example model's
+        # head_dim 16 is refused by both kernels, which would make
+        # every candidate fail correctness vacuously
+        import jax.numpy as jnp
+
+        from ray_lightning_tpu.models.llama import LlamaConfig
+
+        cfg = LlamaConfig(vocab_size=256, dim=128, n_layers=2,
+                          n_heads=2, n_kv_heads=1, hidden_dim=256,
+                          max_seq_len=128, remat=False,
+                          dtype=jnp.float32)
+        ecfg = EngineConfig(capacity=args.slots,
+                            block_size=args.block_size,
+                            blocks_per_slot=args.blocks_per_slot or 8,
+                            prefill_chunk=args.prefill_chunk,
+                            prefill_batch=args.prefill_batch)
+    artifact = sweep_paged_kernels(cfg, ecfg, topology=args.topo)
+    save_artifact(artifact, args.autotune)
+    if getattr(args, "as_json", False):
+        print(json.dumps(artifact))
+    else:
+        n_ok = sum(1 for r in artifact["results"]
+                   if r["decode"].get("ok") and r["prefill"].get("ok"))
+        print(f"swept {len(artifact['results'])} geometries "
+              f"({n_ok} passed both kernels' correctness) on backend "
+              f"{artifact['backend']}")
+        if artifact["winner"]:
+            print(f"winner ({artifact['winner_source']}): block_size="
+                  f"{artifact['winner']['block_size']} "
+                  f"blocks_per_slot="
+                  f"{artifact['winner']['blocks_per_slot']} "
+                  f"-> {args.autotune}")
+        else:
+            print(f"no candidate passed correctness -> "
+                  f"{args.autotune} (winner: null)")
+    return 0 if artifact["winner"] else 1
+
+
 def run_serve(args) -> int:
     if args.smoke:
         return run_smoke(args)
+    if args.autotune:
+        return _run_autotune(args)
     if args.preset == "llama3-8b":
         return _run_flagship(args)
     return _run_example(args)

@@ -136,7 +136,8 @@ def _sample_one(logits, key, temp, top_k):
     return jnp.where(temp == 0.0, greedy, drawn)
 
 
-def build_step(model, cfg: EngineConfig, fused: bool = False):
+def build_step(model, cfg: EngineConfig, fused: bool = False,
+               fused_prefill: bool = False):
     """The jitted continuous-batching step for ``model`` (a
     `models.llama.Llama` instance) under ``cfg``. Returned uncompiled —
     `DecodeEngine` jits it with the pool/logits donated; `serve.audit`
@@ -153,6 +154,19 @@ def build_step(model, cfg: EngineConfig, fused: bool = False):
         `ops.attention.paged_attention`); the per-slot dense view is
         never materialized. Pinned to the reference lane within the
         flash kernel's tolerance discipline (tests/test_paged_attention).
+
+    ``fused_prefill`` selects the PREFILL lane the same way
+    (independently — the two kernels have separate shape gates):
+
+      * False — the reference lane: gather the group's blocks into a
+        dense ``[L, B, G, Hkv, hd]`` view and run the model's chunked
+        cache path over it (the historical program).
+      * True — the fused lane: the model's paged-prefill branch
+        scatters the chunk's K/V straight into owned pool blocks
+        (scratch-redirected for vacant rows) and
+        `ops.attention.paged_prefill` attends causally through the
+        block tables — the per-group gather never exists
+        (tests/test_paged_prefill).
     """
     mcfg = model.cfg
     spec = cfg.pool_spec
@@ -289,22 +303,46 @@ def build_step(model, cfg: EngineConfig, fused: bool = False):
             def do_prefill(pool_k, pool_v, last_logits):
                 slot = jnp.maximum(prefill_slot, 0)
                 row = tables[slot]
-                kc = pool_k[:, row].reshape(L, 1, G, HKV, HD)
-                vc = pool_v[:, row].reshape(L, 1, G, HKV, HD)
-                logits, (nk, nv) = model.apply(
-                    {"params": params}, prefill_tokens[None],
-                    cache=(kc, vc), pos=prefill_pos)
-                kw = jax.lax.dynamic_slice_in_dim(nk[:, 0], prefill_pos,
-                                                  CH, axis=1)
-                vw = jax.lax.dynamic_slice_in_dim(nv[:, 0], prefill_pos,
-                                                  CH, axis=1)
-                # the full CH-wide write is safe past a partial tail
-                # chunk: positions >= prompt_len hold garbage the decode
-                # lane overwrites before any mask ever exposes them
-                wpos = prefill_pos + jnp.arange(CH)
-                wbi = row[wpos // P]
-                pool_k = pool_k.at[:, wbi, wpos % P].set(kw)
-                pool_v = pool_v.at[:, wbi, wpos % P].set(vw)
+                if fused_prefill:
+                    # the fused lane: the pool IS the cache — the
+                    # model's paged-prefill branch scatters the CH-wide
+                    # chunk at the table-named write indices and
+                    # `paged_prefill` streams block tiles, so the
+                    # [L, 1, G, Hkv, hd] gather never exists. The full
+                    # CH-wide write stays safe past a partial tail
+                    # chunk for the same reason as the reference lane:
+                    # tail garbage lands in OWNED blocks and is
+                    # overwritten before any mask exposes it.
+                    from ray_lightning_tpu.ops.attention import (
+                        PagedPrefillView,
+                    )
+
+                    wpos = prefill_pos + jnp.arange(CH)
+                    view = PagedPrefillView(
+                        tables=row[None], write_block=row[wpos // P][None],
+                        write_offset=(wpos % P)[None], use_pallas=True)
+                    logits, (pool_k, pool_v) = model.apply(
+                        {"params": params}, prefill_tokens[None],
+                        cache=(pool_k, pool_v), pos=prefill_pos,
+                        paged=view)
+                else:
+                    kc = pool_k[:, row].reshape(L, 1, G, HKV, HD)
+                    vc = pool_v[:, row].reshape(L, 1, G, HKV, HD)
+                    logits, (nk, nv) = model.apply(
+                        {"params": params}, prefill_tokens[None],
+                        cache=(kc, vc), pos=prefill_pos)
+                    kw = jax.lax.dynamic_slice_in_dim(
+                        nk[:, 0], prefill_pos, CH, axis=1)
+                    vw = jax.lax.dynamic_slice_in_dim(
+                        nv[:, 0], prefill_pos, CH, axis=1)
+                    # the full CH-wide write is safe past a partial tail
+                    # chunk: positions >= prompt_len hold garbage the
+                    # decode lane overwrites before any mask ever
+                    # exposes them
+                    wpos = prefill_pos + jnp.arange(CH)
+                    wbi = row[wpos // P]
+                    pool_k = pool_k.at[:, wbi, wpos % P].set(kw)
+                    pool_v = pool_v.at[:, wbi, wpos % P].set(vw)
                 done_row = logits[0, prefill_last_row]
                 finished = prefill_last_row >= 0
                 last_logits = jnp.where(
@@ -353,24 +391,47 @@ def build_step(model, cfg: EngineConfig, fused: bool = False):
             slots = jnp.maximum(prefill_slots, 0)
             active = prefill_slots >= 0
             rows = jnp.where(active[:, None], tables[slots], 0)
-            kc = pool_k[:, rows].reshape(L, B, G, HKV, HD)
-            vc = pool_v[:, rows].reshape(L, B, G, HKV, HD)
-            logits, (nk, nv) = model.apply(
-                {"params": params}, prefill_tokens,
-                cache=(kc, vc), pos=prefill_pos, pad=prefill_pad)
-            kw = jax.lax.dynamic_slice_in_dim(nk, prefill_pos, CH,
-                                              axis=2)
-            vw = jax.lax.dynamic_slice_in_dim(nv, prefill_pos, CH,
-                                              axis=2)
-            # pad columns land real K/V in owned blocks; they are
-            # masked out of every attention forever (the model's pad
-            # contract), so like partial-tail garbage they can never
-            # reach an unmasked reduction
             wpos = prefill_pos + jnp.arange(CH)
-            wbi = rows[:, wpos // P]
-            woff = jnp.broadcast_to(wpos % P, (B, CH))
-            pool_k = pool_k.at[:, wbi, woff].set(kw)
-            pool_v = pool_v.at[:, wbi, woff].set(vw)
+            if fused_prefill:
+                # the fused lane: the group's left-padded chunk is
+                # scattered straight into owned pool blocks (vacant
+                # rows carry all-scratch tables — their writes and
+                # reads land in masked block 0) and `paged_prefill`
+                # attends causally through the tables; the
+                # [L, B, G, Hkv, hd] per-group gather never exists on
+                # this path. Pad columns land real K/V in owned blocks
+                # exactly as on the reference lane — masked out of
+                # every attention forever.
+                from ray_lightning_tpu.ops.attention import (
+                    PagedPrefillView,
+                )
+
+                view = PagedPrefillView(
+                    tables=rows, write_block=rows[:, wpos // P],
+                    write_offset=jnp.broadcast_to(wpos % P, (B, CH)),
+                    use_pallas=True)
+                logits, (pool_k, pool_v) = model.apply(
+                    {"params": params}, prefill_tokens,
+                    cache=(pool_k, pool_v), pos=prefill_pos,
+                    pad=prefill_pad, paged=view)
+            else:
+                kc = pool_k[:, rows].reshape(L, B, G, HKV, HD)
+                vc = pool_v[:, rows].reshape(L, B, G, HKV, HD)
+                logits, (nk, nv) = model.apply(
+                    {"params": params}, prefill_tokens,
+                    cache=(kc, vc), pos=prefill_pos, pad=prefill_pad)
+                kw = jax.lax.dynamic_slice_in_dim(nk, prefill_pos, CH,
+                                                  axis=2)
+                vw = jax.lax.dynamic_slice_in_dim(nv, prefill_pos, CH,
+                                                  axis=2)
+                # pad columns land real K/V in owned blocks; they are
+                # masked out of every attention forever (the model's
+                # pad contract), so like partial-tail garbage they can
+                # never reach an unmasked reduction
+                wbi = rows[:, wpos // P]
+                woff = jnp.broadcast_to(wpos % P, (B, CH))
+                pool_k = pool_k.at[:, wbi, woff].set(kw)
+                pool_v = pool_v.at[:, wbi, woff].set(vw)
             done = active & (prefill_last_row >= 0)
             done_rows = logits[:, prefill_last_row]      # [B, V]
             # scatter each finished row's logits into its slot via a
@@ -431,16 +492,25 @@ class DecodeEngine:
         # reference lane, the bitwise anchor against generate().
         from ray_lightning_tpu.ops.attention import (
             paged_attention_uses_pallas,
+            paged_prefill_uses_pallas,
         )
 
         spec = cfg.pool_spec
         if use_pallas is None and not model.cfg.use_flash:
             use_pallas = False  # reference-forced model config
+        pool_shape = (spec.n_blocks, spec.block_size,
+                      model.cfg.n_kv_heads, model.cfg.head_dim)
         self.fused = paged_attention_uses_pallas(
             (cfg.capacity, model.cfg.n_heads, model.cfg.head_dim),
-            (spec.n_blocks, spec.block_size, model.cfg.n_kv_heads,
+            pool_shape, use_pallas)
+        # the PREFILL lane's dispatch is decided the same way, once,
+        # here — the two kernels have separate shape gates (the prefill
+        # kernel additionally tiles the chunk width), so the decisions
+        # are independent but share the use_pallas resolution
+        self.fused_prefill = paged_prefill_uses_pallas(
+            (cfg.prefill_batch, cfg.prefill_chunk, model.cfg.n_heads,
              model.cfg.head_dim),
-            use_pallas)
+            pool_shape, use_pallas)
         # canonicalize the weights' placement: trainer-produced params
         # arrive committed to a NamedSharding over the training mesh,
         # and a step closed over those emits NamedSharding outputs —
@@ -455,7 +525,8 @@ class DecodeEngine:
         self.params = jax.device_put(params, jax.devices()[0])
         self.cfg = cfg
         self.spec = cfg.pool_spec
-        self._step = jax.jit(build_step(model, cfg, fused=self.fused),
+        self._step = jax.jit(build_step(model, cfg, fused=self.fused,
+                                        fused_prefill=self.fused_prefill),
                              donate_argnums=(1, 2, 3))
         # COMMIT the device-resident buffers to the same device as the
         # weights: a fresh jnp.zeros is uncommitted, but the step's
@@ -489,6 +560,14 @@ class DecodeEngine:
         """Which decode attention ran for this replica's lifetime —
         surfaced by the bench serving leg and the smoke verdicts."""
         return "paged-pallas" if self.fused else "reference-gather"
+
+    @property
+    def prefill_path(self) -> str:
+        """Which prefill attention ran — the prefill twin of
+        `attention_path` (the fused lane retires the per-group
+        gathered view; docs/SERVING.md 'paged prefill kernel')."""
+        return "paged-pallas" if self.fused_prefill else \
+            "reference-gather"
 
     @property
     def compile_count(self) -> int:

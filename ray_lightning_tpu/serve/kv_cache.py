@@ -108,28 +108,40 @@ def gathered_view_bytes(cfg, spec: PagedPoolSpec, capacity: int) -> int:
 
 def serve_kv_plan_bytes(cfg, spec: PagedPoolSpec, capacity: int,
                         fused: bool = False,
-                        prefill_batch: int = 1) -> dict:
+                        prefill_batch: int = 1,
+                        fused_prefill: bool = False) -> dict:
     """The serving cache's HBM story for the ``plan --serve`` leg:
     itemized pool + gathered view + the per-slot logits buffer the
     engine keeps device-resident between steps.
 
-    ``fused`` selects the attention path being priced. On the fused
-    path the decode lane's capacity-wide dense view is RETIRED — what
-    survives is the prefill lane's per-group gather
-    (``[L, prefill_batch, gathered_len, Hkv, hd]``, the kernel covers
-    decode only), and the retired bytes are itemized so `plan --serve`
-    can state the per-replica HBM the kernel bought back."""
+    ``fused`` selects the DECODE attention path being priced;
+    ``fused_prefill`` the PREFILL path (the two kernels gate shapes
+    independently). On the fused decode path the capacity-wide dense
+    view is RETIRED — what survives is the prefill lane's per-group
+    gather (``[L, prefill_batch, gathered_len, Hkv, hd]``), itemized
+    separately as ``prefill_gather_bytes``; with the fused PREFILL
+    kernel that last copy vanishes too and the view term reaches
+    zero. The retired bytes are itemized so `plan --serve` can state
+    the per-replica HBM the kernels bought back."""
     logits = capacity * cfg.vocab_size * 4  # f32 last_logits
     dense = int(gathered_view_bytes(cfg, spec, capacity))
+    prefill_gather = int(gathered_view_bytes(
+        cfg, spec, min(prefill_batch, capacity)))
+    if fused_prefill:
+        prefill_gather = 0
     if fused:
-        view = int(gathered_view_bytes(cfg, spec,
-                                       min(prefill_batch, capacity)))
+        view = prefill_gather
     else:
+        # the reference decode lane's capacity-wide copy dominates; the
+        # group-sized prefill gather is a slice of the same story (it
+        # is only itemized separately once the decode view is retired)
         view = dense
+        prefill_gather = min(prefill_gather, view)
     return {
         "pool_bytes": int(pool_bytes(cfg, spec)),
         "gathered_view_bytes": view,
         "gathered_view_retired_bytes": dense - view,
+        "prefill_gather_bytes": prefill_gather,
         "last_logits_bytes": int(logits),
     }
 

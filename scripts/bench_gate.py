@@ -88,14 +88,19 @@ STATIC = {"overlap_hidden_fraction"}
 #: dcn_bytes_per_step is the static 2xv5p-64 trace's inter-slice bytes
 #: (ISSUE 9): DCN is the slow tier, so its per-step traffic may only
 #: shrink. serve_hbm_bytes_per_replica is the flagship serving
-#: replica's static per-device HBM on its auto-selected attention path
-#: (ISSUE 11): the fused paged-attention kernel retired the dense
-#: gathered view, and per-replica serving HBM may only shrink from
-#: there. Static class: ratchets on skip lines too; a line carrying
-#: the metric's waiver error field instead waives (analysis bug !=
-#: regression).
+#: replica's static per-device HBM on its auto-selected attention
+#: paths (ISSUE 11; re-anchored to the fused-PREFILL plan by ISSUE 15
+#: — the prefill kernel retired the last dense gather, so the ceiling
+#: now holds at the lower fused-both figure).
+#: serve_prefill_gather_bytes is the prefill lane's surviving dense
+#: per-group gather on the same plan (ISSUE 15): 0 once the fused
+#: prefill kernel covers the flagship shape, and it may only shrink —
+#: nothing may quietly re-materialize the gather. Static class:
+#: ratchets on skip lines too; a line carrying the metric's waiver
+#: error field instead waives (analysis bug != regression).
 CEILING = {"dcn_bytes_per_step": "dcn_bytes_per_step",
-           "serve_hbm_bytes_per_replica": "serve_hbm_bytes_per_replica"}
+           "serve_hbm_bytes_per_replica": "serve_hbm_bytes_per_replica",
+           "serve_prefill_gather_bytes": "serve_prefill_gather_bytes"}
 
 #: ceiling metric -> error fields whose presence waives an ABSENT
 #: value (the analysis that computes the static metric died and said
@@ -104,6 +109,8 @@ CEILING_WAIVERS = {
     "dcn_bytes_per_step": ("multislice_error", "tracecheck_error"),
     "serve_hbm_bytes_per_replica": ("serving_error",
                                     "tracecheck_error"),
+    "serve_prefill_gather_bytes": ("serving_error",
+                                   "tracecheck_error"),
 }
 
 #: ceiling metric -> short rationale for the failure message
@@ -111,9 +118,13 @@ CEILING_WHY = {
     "dcn_bytes_per_step": ("DCN is the slow tier; its per-step "
                            "traffic may only shrink"),
     "serve_hbm_bytes_per_replica": (
-        "per-replica serving HBM may only shrink — the fused "
-        "paged-attention kernel retired the dense gathered view and "
-        "nothing may quietly grow it back"),
+        "per-replica serving HBM may only shrink — the fused paged "
+        "decode + prefill kernels retired the dense gathered views "
+        "and nothing may quietly grow them back"),
+    "serve_prefill_gather_bytes": (
+        "the prefill lane's dense per-group gather is retired by the "
+        "fused paged-prefill kernel — its bytes may only shrink, and "
+        "nothing may quietly re-materialize the gather"),
 }
 
 #: metric -> max allowed value on a measured (non-skip) line; absent or

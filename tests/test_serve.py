@@ -399,8 +399,9 @@ def test_bench_serve_summary_static():
     assert "serving" in s, s.get("serving_error")
     assert s["serving"]["flagship_plan"]["pool_bytes"] > 0
     assert set(s["serving"]["schema"]) == {
-        "decode_tokens_per_s", "ttft_cold_s", "ttft_warm_s",
-        "ttft_p99_s", "slot_occupancy", "serving_attention_path",
+        "decode_tokens_per_s", "prefill_tokens_per_s",
+        "ttft_cold_s", "ttft_warm_s", "ttft_p99_s", "slot_occupancy",
+        "serving_attention_path", "serving_prefill_path",
         "serve_metrics", "scale_up_s", "autoscale"}
 
 
