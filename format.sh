@@ -9,6 +9,7 @@
 #   ./format.sh --check   # CI mode: fail on violations
 set -euo pipefail
 cd "$(dirname "$0")"
+SECONDS=0
 
 RUFF_ARGS=(check ray_lightning_tpu tests examples bench.py __graft_entry__.py)
 
@@ -32,8 +33,47 @@ fi
 
 # shardcheck has no fix mode; it gates both invocations identically.
 # examples/ ship user-facing step code, so they are held to the same bar.
-JAX_PLATFORMS=cpu python -m ray_lightning_tpu lint \
+# --concurrency folds threadcheck (analysis/concurrency.py, RLT7xx:
+# races, lock-order inversions, thread leaks, signal-handler and
+# blocking-under-lock discipline) into the same gate — the package's
+# host-side threading is linted as strictly as its jit-side sharding.
+JAX_PLATFORMS=cpu python -m ray_lightning_tpu lint --concurrency \
     ray_lightning_tpu examples bench.py __graft_entry__.py
+
+# lockwatch smoke (docs/STATIC_ANALYSIS.md "threadcheck & lockwatch"):
+# the runtime half of the concurrency gate. Arm the sanitizer BEFORE
+# the package imports (armed-ness is decided at lock creation), drive a
+# real threaded subsystem (telemetry recorder: a worker thread posting
+# spans while the main thread snapshots), and require a clean order
+# graph. The full suite runs armed too (tests/conftest.py) — this is
+# the seconds-cheap standalone proof the wiring works.
+RLT_LOCKWATCH=1 JAX_PLATFORMS=cpu python -c '
+import threading
+
+from ray_lightning_tpu.analysis.lockwatch import (
+    assert_lockwatch_clean, lockwatch_armed, san_lock)
+
+assert lockwatch_armed(), "RLT_LOCKWATCH=1 not seen by lockwatch"
+from ray_lightning_tpu.analysis.lockwatch import _SanLock
+assert isinstance(san_lock("format.smoke"), _SanLock)
+
+from ray_lightning_tpu.telemetry.spans import (
+    THREAD_PRODUCER, TelemetryRecorder)
+rec = TelemetryRecorder()
+def worker():
+    for i in range(50):
+        with rec.span("format.smoke", step=i, thread=THREAD_PRODUCER):
+            pass
+threads = [threading.Thread(target=worker) for _ in range(4)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+for _ in range(20):
+    rec.phase_totals()
+    rec.last_span()
+assert_lockwatch_clean()
+print("lockwatch smoke: armed, threaded spans clean")'
 
 # tracecheck gate: the flagship Llama-8B v5p-64 step must audit clean at
 # the jaxpr level (no implicit resharding, no ring deadlocks, peak HBM
@@ -169,3 +209,9 @@ print(f"dcn gate: ICI {ici:.1f} GiB/step + DCN {dcn:.3f} GiB/step, "
 # serial schedule — docs/PERFORMANCE.md. Exit 1 otherwise.
 JAX_PLATFORMS=cpu python -m ray_lightning_tpu perf --smoke --steps 25 \
     > /dev/null
+
+# Total wall time of the gate suite. The non-slow pytest tier has a
+# 10-minute budget (ROADMAP); this line keeps the format.sh gates on
+# the same leash — a creeping gate shows up in every run's output
+# instead of only in CI dashboards.
+echo "format.sh: all gates passed in ${SECONDS}s"

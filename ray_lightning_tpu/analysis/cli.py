@@ -55,6 +55,16 @@ def add_lint_parser(sub) -> None:
              "PartitionSpec literals beyond the canonical six")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule table and exit")
+    p.add_argument(
+        "--concurrency", action="store_true", dest="concurrency",
+        default=None,
+        help="also run threadcheck (RLT701-705: races, lock-order "
+             "cycles, thread leaks, signal/lock hygiene). Default: on "
+             "when linting the installed package (self-lint), off for "
+             "explicit targets")
+    p.add_argument(
+        "--no-concurrency", action="store_false", dest="concurrency",
+        help="skip threadcheck even on a package self-lint")
     # same namespace-sharing contract as the plan subparser: a plain
     # default would clobber a `--json` given before the subcommand
     p.add_argument("--json", action="store_true", dest="as_json",
@@ -116,8 +126,21 @@ def run_lint(args) -> int:
     # expand the tree ONCE: lint_paths on plain file paths does no walk,
     # so the count and the linted set cannot disagree
     files = iter_python_files(resolved)
+    all_findings = lint_paths(files, extra_axes=extra_axes)
+    # threadcheck rides along: default-on for the package self-lint
+    # (no explicit targets), opt-in/out via --concurrency/--no-concurrency
+    concurrency = getattr(args, "concurrency", None)
+    if concurrency is None:
+        concurrency = not args.targets
+    if concurrency:
+        from ray_lightning_tpu.analysis.concurrency import (
+            check_concurrency_paths,
+        )
+
+        all_findings = list(all_findings) + list(
+            check_concurrency_paths(files))
     findings = [
-        f for f in lint_paths(files, extra_axes=extra_axes)
+        f for f in all_findings
         if f.rule not in disabled and SEVERITY_RANK[f.severity] >= min_rank
     ]
     findings.sort(key=lambda f: (f.file or "", f.line or 0, f.rule))

@@ -204,6 +204,50 @@ RULES: Dict[str, Rule] = {r.id: r for r in (
          "divisor from the mesh (parallel.mesh.batch_size_divisor, "
          "plan.dp_degree, MeshSpec.resolve) and gate on capability "
          "(> 1), not on a pinned count (docs/ELASTIC.md)"),
+    # RLT7xx — threadcheck (analysis/concurrency.py): host-side
+    # concurrency. The host orchestration around jit is a real threaded
+    # system (prefetch producer, checkpoint finalizer, heartbeats,
+    # report servers); these rules audit it the way RLT1xx audits the
+    # sharding plan. RLT702/RLT705 are also emitted at RUNTIME by the
+    # lock-order sanitizer (analysis/lockwatch.py) — same id, proven by
+    # observation instead of from source text.
+    Rule("RLT701", "unguarded-shared-mutation", "error",
+         "an instance attribute is WRITTEN in thread-reachable code "
+         "(the body of a threading.Thread target, or anything it calls "
+         "in-file) and read or written outside it with no common lock "
+         "held at both sites — a data race on host state. Guard both "
+         "sides with one lock, or hand the value over through a "
+         "synchronized carrier (queue.Queue, threading.Event, "
+         "deque(maxlen=...) — their receivers are sanctioned as their "
+         "own synchronization)"),
+    Rule("RLT702", "lock-order-inversion", "error",
+         "the package-wide lock-acquisition graph (lock B acquired "
+         "while lock A is held, from nested `with` chains and "
+         "cross-function calls) contains a cycle: two threads taking "
+         "the locks in opposite orders can deadlock. Impose one global "
+         "order, or narrow one critical section so the locks are never "
+         "held together"),
+    Rule("RLT703", "thread-leak", "warning",
+         "a started non-daemon thread has no join() on any path (not "
+         "joined in the spawning scope, a finally, or a close/shutdown "
+         "method of the owning class): process exit blocks on it "
+         "forever. Join it on the exit path, or mark it daemon=True if "
+         "abandoning mid-work is genuinely safe"),
+    Rule("RLT704", "signal-unsafe-handler", "warning",
+         "a signal.signal handler does more than flag-and-return "
+         "(set a flag/Event, os.write to a raw fd, os._exit) — locks, "
+         "print/logging, file I/O, or queue ops inside a handler can "
+         "deadlock on the interrupted thread's own held resources. "
+         "The bench.py/preempt.py discipline: the handler records, the "
+         "loop reacts at the next batch boundary"),
+    Rule("RLT705", "blocking-call-under-lock", "warning",
+         "a blocking call (sleep, thread join, subprocess, untimed "
+         "queue.get/put, file/socket I/O) runs while a lock is held, "
+         "stalling every thread contending for it. Copy state out "
+         "under the lock and do the slow work outside. A lock whose "
+         "EVERY critical section is the same I/O (a dedicated "
+         "append-serialization lock) is sanctioned — the hazard is a "
+         "lock that also guards in-memory state"),
 )}
 
 

@@ -41,6 +41,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import orbax.checkpoint as ocp
 
+from ray_lightning_tpu.analysis.lockwatch import san_lock
 from ray_lightning_tpu.utils import get_logger
 
 log = get_logger(__name__)
@@ -55,7 +56,7 @@ _META_FILE = "meta.json"
 # write commits (or by wait_for_checkpoints / the next blocking save,
 # whichever runs first); _META_LOCK guards the list.
 _PENDING_META: List[Tuple[str, Dict[str, Any]]] = []
-_META_LOCK = threading.Lock()
+_META_LOCK = san_lock("checkpoint.io.meta")
 
 #: paths whose meta/digest the finalizer thread is writing RIGHT NOW —
 #: deletion (checkpoint pruning) must not rmtree a dir mid-digest-walk.
@@ -91,7 +92,7 @@ _CKPT: Optional[ocp.StandardCheckpointer] = None
 #: early and meta could be published over a still-streaming write.
 #: Holding the lock through a wait costs nothing extra: a concurrent
 #: save would have waited for the in-flight write inside orbax anyway.
-_CK_LOCK = threading.RLock()
+_CK_LOCK = san_lock("checkpoint.io.ck", reentrant=True)
 
 
 def _checkpointer() -> ocp.StandardCheckpointer:

@@ -32,6 +32,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import cloudpickle
 
+from ray_lightning_tpu.analysis.lockwatch import san_lock
 from ray_lightning_tpu.runtime.transport import LocalTransport, Transport
 from ray_lightning_tpu.utils import get_logger
 
@@ -108,7 +109,7 @@ class _HelloAcceptor:
         self._open = True
         # serializes enqueue-vs-close so a connection that authenticates
         # concurrently with close() is closed, never stranded on the queue
-        self._lock = threading.Lock()
+        self._lock = san_lock("runtime.group.accept")
         self._conns: "queue.Queue" = queue.Queue()
         # The split accept/auth path rides on stdlib internals
         # (Listener._listener raw accept; the deliver/answer challenge

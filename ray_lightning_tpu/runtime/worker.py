@@ -55,6 +55,8 @@ from multiprocessing.connection import Client
 
 import cloudpickle
 
+from ray_lightning_tpu.analysis.lockwatch import san_lock
+
 #: stamped at import — the earliest observable moment of this worker's
 #: life; telemetry's goodput "launch" bucket (spawn -> fit start)
 #: measures against it via the session registry
@@ -88,7 +90,7 @@ class _WorkerChannel:
         self.conn = conn
         self.rank = rank
         self.world = world
-        self._lock = threading.Lock()
+        self._lock = san_lock("runtime.worker.channel")
 
     def send(self, msg) -> None:
         with self._lock:

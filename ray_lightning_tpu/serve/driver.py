@@ -66,6 +66,7 @@ import numpy as np
 
 from ray_lightning_tpu.serve.engine import DecodeEngine, EngineConfig
 from ray_lightning_tpu.serve.scheduler import Completion, Request, Scheduler
+from ray_lightning_tpu.analysis.lockwatch import san_lock
 from ray_lightning_tpu.utils import get_logger
 
 log = get_logger(__name__)
@@ -573,7 +574,7 @@ class ServeDriver:
             outputs[req.rid] = []
         restarts = {r: 0 for r in range(n)}
         errors: List[BaseException] = []
-        lock = threading.Lock()
+        lock = san_lock("serve.driver.batch")
         fault_dir = self.cfg.run_dir or os.path.join(
             os.getcwd(), "rlt_logs", "serve")
         os.makedirs(fault_dir, exist_ok=True)

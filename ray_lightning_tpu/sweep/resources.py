@@ -17,6 +17,8 @@ import threading
 from dataclasses import dataclass
 from typing import Optional
 
+from ray_lightning_tpu.analysis.lockwatch import san_lock
+
 
 @dataclass(frozen=True)
 class TpuResources:
@@ -62,7 +64,7 @@ class ResourcePool:
         self.total_cpus = total_cpus
         self._in_use = 0
         self._cpus_in_use = 0
-        self._lock = threading.Lock()
+        self._lock = san_lock("sweep.resources.pool")
 
     def max_concurrent(self, per_trial: TpuResources) -> int:
         """floor(topology / per-trial shape) — SURVEY §7.4 #4 — jointly

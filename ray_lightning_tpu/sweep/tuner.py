@@ -29,8 +29,9 @@ import threading
 import traceback
 from collections import deque
 from multiprocessing.connection import Listener
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ray_lightning_tpu.analysis.lockwatch import san_lock
 from ray_lightning_tpu.runtime.group import WorkerGroup, WorkerError
 from ray_lightning_tpu.sweep import session as trial_session
 from ray_lightning_tpu.sweep.analysis import ExperimentAnalysis, Trial
@@ -58,7 +59,7 @@ class _HostPool:
 
     def __init__(self, hosts):
         self._free = list(hosts)
-        self._lock = threading.Lock()
+        self._lock = san_lock("sweep.tuner.hosts")
 
     def try_acquire(self, n: int):
         with self._lock:
@@ -328,7 +329,7 @@ class TrialRunner:
                 f"pool has {pool.total_chips}"
             )
         self.max_concurrent = min(max_concurrent or cap, cap)
-        self._lock = threading.Lock()
+        self._lock = san_lock("sweep.tuner.runner")
         self._cond = threading.Condition(self._lock)
         self.trials: List[Trial] = []
         for i, cfg in enumerate(configs):
@@ -348,15 +349,15 @@ class TrialRunner:
     def _state_path(self, trial: Trial) -> str:
         return os.path.join(trial.trial_dir, "trial_state.json")
 
-    def _save_trial_state(self, trial: Trial) -> None:
-        """Durable per-trial record (atomic rename) so a later sweep.run
-        over the same storage_dir can skip DONE trials and resume the rest."""
+    def _snapshot_trial_state(self, trial: Trial) -> Tuple[str, Dict]:
+        """Copy the mutable trial record (cheap, in-memory) — safe to
+        call under self._lock; the file write happens outside it."""
         import json
 
         state = {
             "status": trial.status,
-            "history": trial.history,
-            "checkpoints": trial.checkpoints,
+            "history": list(trial.history),
+            "checkpoints": list(trial.checkpoints),
             "error": trial.error,
         }
         try:
@@ -364,14 +365,29 @@ class TrialRunner:
             state["result"] = trial.result
         except (TypeError, ValueError):
             pass  # non-JSON trainable return: status/history still persist
-        path = self._state_path(trial)
+        return self._state_path(trial), state
+
+    def _write_trial_state(self, trial_id: str, path: str,
+                           state: Dict) -> None:
+        import json
+
         tmp = path + ".tmp"
         try:
             with open(tmp, "w") as f:
                 json.dump(state, f)
             os.replace(tmp, path)
         except (OSError, TypeError, ValueError) as exc:
-            log.warning("could not persist %s state: %s", trial.trial_id, exc)
+            log.warning("could not persist %s state: %s", trial_id, exc)
+
+    def _save_trial_state(self, trial: Trial) -> None:
+        """Durable per-trial record (atomic rename) so a later sweep.run
+        over the same storage_dir can skip DONE trials and resume the rest.
+        Never call this holding self._lock — snapshot under the lock and
+        write outside (threadcheck RLT705: every report thread and the
+        scheduler loop contend on that lock; disk latency must not
+        serialize them)."""
+        path, state = self._snapshot_trial_state(trial)
+        self._write_trial_state(trial.trial_id, path, state)
 
     def _load_trial_state(self, trial: Trial) -> None:
         import json
@@ -428,8 +444,13 @@ class TrialRunner:
             if verdict != CONTINUE:
                 log.info("scheduler stopping %s at iteration %d", trial_id,
                          iteration)
-            self._save_trial_state(trial)
-            return verdict
+            path, state = self._snapshot_trial_state(trial)
+        # The state file write runs OUTSIDE self._lock: every report
+        # thread and the scheduler loop contend on it, and a slow disk
+        # must not serialize trial scheduling (RLT705 regression,
+        # pinned by test_concurrency_lint.py).
+        self._write_trial_state(trial_id, path, state)
+        return verdict
 
     # --------------------------------------------------------------- retry
     def _retry_delay(self, trial: "Trial",

@@ -38,12 +38,14 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from ray_lightning_tpu.analysis.lockwatch import san_lock
+
 INCIDENTS_NAME = "incidents.jsonl"
 INCIDENTS_VERSION = "rlt-incidents-v1"
 
 #: serializes header-write + append: a supervisor poll and a controller
 #: poll sharing one run dir must interleave whole lines
-_APPEND_LOCK = threading.Lock()
+_APPEND_LOCK = san_lock("telemetry.incidents.append")
 
 
 def incidents_path(run_dir: str) -> str:

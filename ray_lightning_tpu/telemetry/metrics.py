@@ -49,6 +49,8 @@ import threading
 import time
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
+from ray_lightning_tpu.analysis.lockwatch import san_lock
+
 METRICS_VERSION = "rlt-metrics-v1"
 FLIGHT_VERSION = "rlt-flight-v1"
 
@@ -249,7 +251,7 @@ class MetricsRegistry:
         #: consumers and deliberately stay OUT of the replica rollups
         self.prefix = prefix
         self.flush_every_n_ticks = max(1, flush_every_n_ticks)
-        self._lock = threading.Lock()
+        self._lock = san_lock("telemetry.metrics.recorder")
         self._counters: Dict[str, int] = {}
         self._gauges: Dict[str, float] = {}
         self._hists: Dict[str, Histogram] = {}
@@ -750,7 +752,7 @@ class FlightRecorder:
         self.persist_every = max(1, persist_every)
         self.events: collections.deque = collections.deque(maxlen=maxlen)
         self._since_persist = 0
-        self._lock = threading.Lock()
+        self._lock = san_lock("telemetry.metrics.flight")
         self.t0_perf = time.perf_counter()
         self.t0_wall = time.time()
         self.uid = f"{os.getpid()}-{next(_FILE_SEQ)}"
@@ -832,7 +834,7 @@ def read_flight(path: str) -> Optional[dict]:
 #: driver finalizes deaths from one thread PER REPLICA, and two
 #: replicas dying together (node OOM kills both) must append two
 #: dumps, not race each other's rewrite
-_FLIGHT_OUT_LOCK = threading.Lock()
+_FLIGHT_OUT_LOCK = san_lock("telemetry.metrics.flight_out")
 
 
 def finalize_flight(telemetry_dir: str, replica: int, death: dict,

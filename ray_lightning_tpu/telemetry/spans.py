@@ -38,6 +38,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from ray_lightning_tpu.analysis.lockwatch import san_lock
+
 #: per-process recorder sequence: a second fit in the same process (or
 #: two trainers sharing one telemetry dir — the sweep inline executor)
 #: must get its OWN files, never truncate an earlier recorder's
@@ -157,7 +159,7 @@ class TelemetryRecorder:
         self.directory = directory
         self.rank = rank
         self.enabled = True
-        self._lock = threading.Lock()
+        self._lock = san_lock("telemetry.spans.recorder")
         self._ring: collections.deque = collections.deque(maxlen=ring_size)
         self._totals: Dict[str, float] = {}
         self._counts: Dict[str, int] = {}

@@ -215,6 +215,7 @@ def test_kernel_verify_crash_degrades_not_fatal(monkeypatch):
     assert "pallas crashed" in out["kernel_verify_error"]
 
 
+@pytest.mark.slow  # sleeps by design: must outwait the watchdog window
 def test_watchdog_fires_on_hang():
     """A hang anywhere in the run (wedged device tunnel: every op blocks
     forever) must yield the structured error JSON and exit 3 within the

@@ -1,0 +1,64 @@
+"""bench.py structural pins: leg ordering and the static analysis block.
+
+``bench._run`` executes measurement legs in ``LEG_ORDER`` — flagship
+legs first so a watchdog kill mid-run still flushes driver-verified
+flagship numbers (the legs a partial sink MUST contain). These tests
+pin that order and the one data dependency inside it, plus the
+``concurrency`` summary block every bench JSON line now carries.
+"""
+import ast
+import os
+
+import bench
+
+_BENCH_PY = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py")
+
+
+def test_leg_order_is_flagship_first():
+    """A watchdog timeout or driver kill flushes the partial sink; the
+    flagship numbers must already be in it."""
+    order = list(bench.LEG_ORDER)
+    flagship_legs = [n for n in order if n.startswith("flagship")]
+    assert order[:len(flagship_legs)] == flagship_legs, order
+    # comparison/secondary legs all come after
+    assert order.index("vs_baseline") > order.index("flagship")
+
+
+def test_rematce_immediately_precedes_flagship():
+    """`_flagship_remat_ce` publishes shared["rematce"], the flagship
+    leg's compile-rejection fallback — the dependency that makes the
+    order a contract rather than a preference."""
+    order = list(bench.LEG_ORDER)
+    i = order.index("flagship_rematce")
+    assert order[i + 1] == "flagship", order
+
+
+def test_run_iterates_exactly_leg_order():
+    """_run's dispatch table and LEG_ORDER name the same set, and the
+    loop walks LEG_ORDER — verified in the AST so a hand-reordered
+    `leg(...)` call sequence cannot silently diverge from the pin."""
+    with open(_BENCH_PY, "r", encoding="utf-8") as fh:
+        tree = ast.parse(fh.read())
+    run = next(n for n in tree.body
+               if isinstance(n, ast.FunctionDef) and n.name == "_run")
+    loops = [n for n in ast.walk(run)
+             if isinstance(n, ast.For)
+             and isinstance(n.iter, ast.Name)
+             and n.iter.id == "LEG_ORDER"]
+    assert loops, "_run no longer iterates LEG_ORDER"
+    # no stray direct leg("name", ...) calls outside the LEG_ORDER loop
+    direct = [n for n in ast.walk(run)
+              if isinstance(n, ast.Call)
+              and isinstance(n.func, ast.Name) and n.func.id == "leg"
+              and n.args and isinstance(n.args[0], ast.Constant)]
+    assert direct == [], [ast.dump(d) for d in direct]
+
+
+def test_concurrency_summary_block():
+    """Every bench JSON line carries the threadcheck audit — and on this
+    repo it reports zero findings (the self-lint pin, from the bench
+    side). Pure host-side AST work: must succeed with no backend."""
+    block = bench._concurrency_summary()
+    assert set(block) == {"concurrency"}
+    assert block["concurrency"] == {"total": 0, "by_rule": {}}

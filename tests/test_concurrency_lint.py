@@ -1,0 +1,401 @@
+"""threadcheck (analysis/concurrency.py) — the RLT7xx fixture matrix.
+
+Every rule gets a fire case AND its sanction cases (the sanctions are
+the rule's contract as much as the fire is: a race detector that flags
+queue handoffs would be unusable). Sources go through
+``check_concurrency_sources`` exactly as the CLI feeds files, so the
+suppression syntax and the package-level finalization passes (the
+dedicated-I/O-lock sanction, the cross-file order graph) are all on the
+hook here.
+
+The last tests pin the two self-referential guarantees: the package
+itself lints clean (``lint --concurrency`` is default-on for self-lint),
+and the tuner-shaped write-under-lock defect fixed in this PR stays
+detectable — reintroducing it anywhere trips RLT705 via the repo-clean
+pin.
+"""
+import os
+
+from ray_lightning_tpu.analysis.concurrency import (
+    check_concurrency_paths,
+    check_concurrency_sources,
+    summarize,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rules(src, name="fixture.py", extra=()):
+    pairs = [(name, src)] + list(extra)
+    return sorted({f.rule for f in check_concurrency_sources(pairs)})
+
+
+def _findings(src, name="fixture.py"):
+    return check_concurrency_sources([(name, src)])
+
+
+# ---- RLT701 unguarded-shared-mutation --------------------------------------
+
+_SRC_701_FIRE = """
+import threading
+
+class Pump:
+    def __init__(self):
+        self.buf = []
+        self.t = threading.Thread(target=self._run, daemon=True)
+        self.t.start()
+
+    def _run(self):
+        self.buf.append(1)
+
+    def read(self):
+        return len(self.buf)
+"""
+
+
+def test_rlt701_fires_on_unguarded_shared_list():
+    fs = _findings(_SRC_701_FIRE)
+    assert [f.rule for f in fs] == ["RLT701"], fs
+    assert "self.buf" in fs[0].message
+    assert "_run" in fs[0].message and "read" in fs[0].message
+
+
+def test_rlt701_common_lock_sanctions():
+    src = """
+import threading
+
+class Pump:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.buf = []
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+
+    def _run(self):
+        with self.lock:
+            self.buf.append(1)
+
+    def read(self):
+        with self.lock:
+            return len(self.buf)
+"""
+    assert _rules(src) == [], _findings(src)
+
+
+def test_rlt701_queue_handoff_sanctions():
+    src = """
+import queue
+import threading
+
+class Pump:
+    def __init__(self):
+        self.q = queue.Queue()
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+
+    def _run(self):
+        self.q.put_nowait(1)
+
+    def read(self):
+        return self.q.get(timeout=1)
+"""
+    assert _rules(src) == [], _findings(src)
+
+
+def test_rlt701_event_flag_sanctions():
+    src = """
+import threading
+
+class Pump:
+    def __init__(self):
+        self.done = threading.Event()
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+
+    def _run(self):
+        self.done.set()
+
+    def poll(self):
+        return self.done.is_set()
+"""
+    assert _rules(src) == [], _findings(src)
+
+
+def test_rlt701_bounded_deque_sanctions():
+    src = """
+import collections
+import threading
+
+class Pump:
+    def __init__(self):
+        self.buf = collections.deque(maxlen=8)
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+
+    def _run(self):
+        self.buf.append(1)
+
+    def read(self):
+        return list(self.buf)
+"""
+    assert _rules(src) == [], _findings(src)
+
+
+def test_rlt701_inline_suppression():
+    src = _SRC_701_FIRE.replace(
+        "self.buf.append(1)",
+        "self.buf.append(1)  # rlt: disable=RLT701")
+    assert _rules(src) == [], _findings(src)
+
+
+# ---- RLT702 lock-order-inversion -------------------------------------------
+
+_SRC_702_FIRE = """
+import threading
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+
+def one():
+    with lock_a:
+        with lock_b:
+            pass
+
+def two():
+    with lock_b:
+        with lock_a:
+            pass
+"""
+
+
+def test_rlt702_fires_on_opposite_nesting():
+    fs = _findings(_SRC_702_FIRE)
+    assert [f.rule for f in fs] == ["RLT702"], fs
+    assert "cycle" in fs[0].message
+
+
+def test_rlt702_consistent_order_sanctions():
+    src = """
+import threading
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+
+def one():
+    with lock_a:
+        with lock_b:
+            pass
+
+def two():
+    with lock_a:
+        with lock_b:
+            pass
+"""
+    assert _rules(src) == [], _findings(src)
+
+
+def test_rlt702_cycle_detected_across_files():
+    """san_lock names are the package-wide lock identity: file one nests
+    A under B, file two nests B under A — neither file alone has a
+    cycle."""
+    f1 = """
+from ray_lightning_tpu.analysis.lockwatch import san_lock
+
+la = san_lock("x.alpha")
+lb = san_lock("x.beta")
+
+def fwd():
+    with la:
+        with lb:
+            pass
+"""
+    f2 = """
+from ray_lightning_tpu.analysis.lockwatch import san_lock
+
+la = san_lock("x.alpha")
+lb = san_lock("x.beta")
+
+def rev():
+    with lb:
+        with la:
+            pass
+"""
+    fs = check_concurrency_sources([("one.py", f1), ("two.py", f2)])
+    assert [f.rule for f in fs] == ["RLT702"], fs
+    assert "x.alpha" in fs[0].message and "x.beta" in fs[0].message
+    # each file alone is clean
+    assert check_concurrency_sources([("one.py", f1)]) == []
+    assert check_concurrency_sources([("two.py", f2)]) == []
+
+
+# ---- RLT703 thread-leak ----------------------------------------------------
+
+_SRC_703_FIRE = """
+import threading
+
+def spawn(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    return t
+"""
+
+
+def test_rlt703_fires_on_unjoined_nondaemon():
+    fs = _findings(_SRC_703_FIRE)
+    assert [f.rule for f in fs] == ["RLT703"], fs
+    assert "join" in fs[0].message
+
+
+def test_rlt703_join_sanctions():
+    src = """
+import threading
+
+def run(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join()
+"""
+    assert _rules(src) == [], _findings(src)
+
+
+def test_rlt703_daemon_sanctions():
+    src = """
+import threading
+
+def spawn(fn):
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+    return t
+"""
+    assert _rules(src) == [], _findings(src)
+
+
+# ---- RLT704 signal-unsafe-handler ------------------------------------------
+
+_SRC_704_FIRE = """
+import signal
+
+def _handler(signum, frame):
+    print("caught", signum)
+
+signal.signal(signal.SIGTERM, _handler)
+"""
+
+
+def test_rlt704_fires_on_print_in_handler():
+    fs = _findings(_SRC_704_FIRE)
+    assert [f.rule for f in fs] == ["RLT704"], fs
+    assert "_handler" in fs[0].message
+
+
+def test_rlt704_flag_only_discipline_sanctions():
+    src = """
+import os
+import signal
+
+FLAG = {"stop": False}
+
+def _handler(signum, frame):
+    FLAG["stop"] = True
+    os.write(2, b"sig\\n")
+
+signal.signal(signal.SIGTERM, _handler)
+"""
+    assert _rules(src) == [], _findings(src)
+
+
+# ---- RLT705 blocking-call-under-lock ---------------------------------------
+
+_SRC_705_FIRE = """
+import threading
+import time
+
+_lock = threading.Lock()
+
+def slow():
+    with _lock:
+        time.sleep(1.0)
+"""
+
+
+def test_rlt705_fires_on_sleep_under_lock():
+    fs = _findings(_SRC_705_FIRE)
+    assert [f.rule for f in fs] == ["RLT705"], fs
+    assert "sleep" in fs[0].message
+
+
+def test_rlt705_dedicated_io_lock_sanctions():
+    """A lock whose EVERY critical section is the same I/O exists to
+    serialize that I/O — the append-ledger pattern, not a hazard."""
+    src = """
+import threading
+
+_append_lock = threading.Lock()
+
+def append_line(path, line):
+    with _append_lock:
+        with open(path, "a") as fh:
+            fh.write(line)
+"""
+    assert _rules(src) == [], _findings(src)
+
+
+def test_rlt705_timeout_queue_op_sanctions():
+    src = """
+import queue
+import threading
+
+_lock = threading.Lock()
+_q = queue.Queue()
+
+def poll():
+    with _lock:
+        return _q.get(timeout=0.1)
+"""
+    assert _rules(src) == [], _findings(src)
+
+
+def test_rlt705_tuner_shaped_write_under_lock_fires():
+    """The defect class fixed in sweep/tuner.py this PR: file write
+    reached THROUGH a helper called under a state lock. Cross-call
+    attribution must still see it — and the lock is NOT io-dedicated
+    because its section also mutates in-memory state."""
+    src = """
+import threading
+
+class Runner:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = {}
+
+    def _write(self, path):
+        with open(path, "w") as fh:
+            fh.write("x")
+
+    def handle(self, path):
+        with self._lock:
+            self.state["n"] = 1
+            self._write(path)
+"""
+    fs = _findings(src)
+    assert "RLT705" in [f.rule for f in fs], fs
+    assert any("_write" in f.message for f in fs if f.rule == "RLT705")
+
+
+# ---- the package self-lint pin ---------------------------------------------
+
+def test_repo_lints_clean_under_threadcheck():
+    """`python -m ray_lightning_tpu lint --concurrency` exits clean on
+    the package — the regression pin for every concurrency fix this
+    analyzer forced (tuner snapshot/write split, native suppression,
+    the san_lock migrations)."""
+    pkg = os.path.join(_REPO, "ray_lightning_tpu")
+    fs = check_concurrency_paths([pkg])
+    assert fs == [], "\n".join(f.format() for f in fs)
+
+
+def test_summarize_counts_by_rule():
+    fs = _findings(_SRC_701_FIRE) + _findings(_SRC_703_FIRE)
+    s = summarize(fs)
+    assert s == {"total": 2, "by_rule": {"RLT701": 1, "RLT703": 1}}
+    assert summarize([]) == {"total": 0, "by_rule": {}}

@@ -233,6 +233,11 @@ class TestInlineBackward:
                                 inline_backward=True)
         np.testing.assert_allclose(np.asarray(b), np.asarray(a), rtol=1e-6)
 
+    @pytest.mark.slow  # ~10s double train-step compile; the inline-bwd
+    #                    grads stay pinned in tier-1 by
+    #                    test_loss_and_grads_match_reference and the
+    #                    module wiring by
+    #                    test_llama_module_fused_vs_logits_loss
     def test_module_end_to_end_grads(self):
         """LlamaModule(ce_inline_bwd=True): full train-step grads match
         the default fused path's on the same params/batch."""

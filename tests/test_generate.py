@@ -16,16 +16,11 @@ from ray_lightning_tpu.models.llama import (
 )
 
 
-@pytest.fixture(scope="module")
-def tiny():
-    cfg = LlamaConfig.tiny(use_flash=False, dtype=jnp.float32)
-    model = Llama(cfg)
-    tokens = np.asarray(
-        jax.random.randint(jax.random.key(0), (2, 8), 0, cfg.vocab_size),
-        dtype=np.int32,
-    )
-    params = jax.jit(model.init)(jax.random.key(1), tokens)["params"]
-    return cfg, model, params, tokens
+@pytest.fixture
+def tiny(tiny_llama_f32):
+    # the session-scope canonical build (tests/conftest.py) — same cfg,
+    # same keys this fixture used to construct per-module
+    return tiny_llama_f32
 
 
 def _greedy_nocache(model, params, prompt, n):

@@ -39,6 +39,8 @@ def _hf_bert(cfg: BertConfig):
     return model
 
 
+@pytest.mark.slow  # ~18s compile; HF-bert parity stays in tier-1 via
+#                    the classifier/pooler test (encoder + head on top)
 def test_bert_encoder_matches_hf():
     cfg = BertConfig.tiny(dtype=jnp.float32, dropout=0.0, use_flash=False)
     hf = _hf_bert(cfg)

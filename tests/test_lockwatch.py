@@ -1,0 +1,167 @@
+"""lockwatch (analysis/lockwatch.py) — the runtime half of threadcheck.
+
+These tests drive ``_SanLock`` directly (armed-ness is decided at
+``san_lock()`` call time from the env, so the wrapper class is the
+deterministic unit) and snapshot/restore the process-global order graph
+around each test: the suite-wide sanitizer verdict in conftest's
+``pytest_sessionfinish`` must keep seeing the REAL package's
+acquisitions, not the synthetic cycles built here.
+"""
+import threading
+import time
+
+import pytest
+
+from ray_lightning_tpu.analysis import lockwatch
+from ray_lightning_tpu.analysis.lockwatch import (
+    _SanLock,
+    assert_lockwatch_clean,
+    lockwatch_armed,
+    lockwatch_cycles,
+    lockwatch_findings,
+    san_lock,
+)
+
+
+@pytest.fixture
+def fresh_watch():
+    """Run against an empty order graph; restore the suite's real
+    observations (and this thread's held-stack) afterwards."""
+    with lockwatch._META:
+        order = {k: dict(v) for k, v in lockwatch._ORDER.items()}
+        findings = list(lockwatch._FINDINGS)
+        cycles = list(lockwatch._CYCLES)
+    lockwatch.reset_lockwatch()
+    try:
+        yield
+    finally:
+        with lockwatch._META:
+            lockwatch._ORDER.clear()
+            lockwatch._ORDER.update(order)
+            lockwatch._FINDINGS[:] = findings
+            lockwatch._CYCLES[:] = cycles
+        if getattr(lockwatch._TLS, "stack", None):
+            lockwatch._TLS.stack = []
+
+
+def test_armed_factory_returns_wrapper(monkeypatch):
+    monkeypatch.setenv("RLT_LOCKWATCH", "1")
+    assert lockwatch_armed()
+    assert isinstance(san_lock("lwt.factory"), _SanLock)
+
+
+def test_disarmed_factory_returns_plain_lock(monkeypatch):
+    monkeypatch.setenv("RLT_LOCKWATCH", "0")
+    assert not lockwatch_armed()
+    lk = san_lock("lwt.plain")
+    assert not isinstance(lk, _SanLock)
+    with lk:  # a real lock, zero wrapper
+        pass
+    rlk = san_lock("lwt.plain.r", reentrant=True)
+    with rlk:
+        with rlk:
+            pass
+
+
+def test_cycle_detected_from_one_execution_order(fresh_watch):
+    """A->B then B->A in ONE thread: the opposite interleaving never
+    runs, the cycle is still diagnosed."""
+    a, b = _SanLock("LWT_A"), _SanLock("LWT_B")
+    with a:
+        with b:
+            pass
+    assert lockwatch_cycles() == []
+    with b:
+        with a:
+            pass
+    cycles = lockwatch_cycles()
+    assert len(cycles) == 1
+    assert set(cycles[0]) == {"LWT_A", "LWT_B"}
+    f = [f for f in lockwatch_findings() if f.rule == "RLT702"]
+    assert len(f) == 1 and "cycle" in f[0].message
+    with pytest.raises(AssertionError, match="lock-order cycle"):
+        assert_lockwatch_clean()
+
+
+def test_consistent_order_stays_clean(fresh_watch):
+    a, b, c = _SanLock("LWT_1"), _SanLock("LWT_2"), _SanLock("LWT_3")
+    for _ in range(3):
+        with a:
+            with b:
+                with c:
+                    pass
+    assert lockwatch_cycles() == []
+    assert lockwatch_findings() == []
+    assert_lockwatch_clean()
+
+
+def test_identity_is_the_name_not_the_instance(fresh_watch):
+    """Two different instances of the same name are one lockdep class:
+    the cycle is caught even though no single PAIR of instances was
+    ever taken in both orders."""
+    with _SanLock("LWT_N1"):
+        with _SanLock("LWT_N2"):
+            pass
+    with _SanLock("LWT_N2"):  # fresh instances, same names
+        with _SanLock("LWT_N1"):
+            pass
+    assert len(lockwatch_cycles()) == 1
+
+
+def test_self_deadlock_raises_instead_of_hanging(fresh_watch):
+    a = _SanLock("LWT_SELF")
+    a.acquire()
+    with pytest.raises(RuntimeError, match="re-acquired non-reentrant"):
+        a.acquire()
+    a.release()
+    f = [f for f in lockwatch_findings() if f.rule == "RLT702"]
+    assert len(f) == 1 and "re-acquire" in f[0].message
+
+
+def test_reentrant_nesting_is_legal(fresh_watch):
+    r = _SanLock("LWT_R", reentrant=True)
+    with r:
+        with r:
+            assert r._is_owned()
+    assert not r._is_owned()
+    assert lockwatch_findings() == []
+
+
+def test_held_too_long_reports_rlt705(fresh_watch, monkeypatch):
+    monkeypatch.setenv("RLT_LOCKWATCH_MAX_HOLD_S", "0.05")
+    slow = _SanLock("LWT_SLOW")  # threshold read at construction
+    with slow:
+        time.sleep(0.08)
+    f = [f for f in lockwatch_findings() if f.rule == "RLT705"]
+    assert len(f) == 1
+    assert "LWT_SLOW" in f[0].message and f[0].severity == "warning"
+    # held-too-long is report-only: never a cycle, never a hard failure
+    assert lockwatch_cycles() == []
+    assert_lockwatch_clean()
+
+
+def test_condition_protocol_over_san_lock(fresh_watch):
+    """threading.Condition(san_lock(...)) — wait() fully releases the
+    watched lock (another thread can notify) and restores it after."""
+    lk = _SanLock("LWT_CV")
+    cv = threading.Condition(lk)
+    hit = []
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=5)
+            hit.append(lk._is_owned())
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        with cv:
+            if cv._waiters:
+                cv.notify()
+                break
+        time.sleep(0.01)
+    t.join(5)
+    assert hit == [True]
+    assert not lk._is_owned()
+    assert lockwatch_findings() == []

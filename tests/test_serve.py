@@ -22,16 +22,18 @@ from ray_lightning_tpu.serve.scheduler import Request, Scheduler
 
 
 @pytest.fixture(scope="module")
-def tiny():
-    cfg = LlamaConfig.tiny(use_flash=False, dtype=jnp.float32)
-    model = Llama(cfg)
+def tiny(tiny_llama_f32):
+    # params from the session-scope canonical build (tests/conftest.py):
+    # same cfg, same init key 1 — init params depend only on key and
+    # param shapes, so the shared build is bitwise what this fixture
+    # used to construct per-module
+    cfg, model, params, _ = tiny_llama_f32
     prompts = [
         np.array(jax.random.randint(
             jax.random.key(10 + i), (1, 3 + (i % 5)), 0,
             cfg.vocab_size), dtype=np.int32)
         for i in range(8)
     ]
-    params = jax.jit(model.init)(jax.random.key(1), prompts[0])["params"]
     return cfg, model, params, prompts
 
 

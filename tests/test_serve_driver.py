@@ -23,16 +23,18 @@ from ray_lightning_tpu.serve.scheduler import Request
 
 
 @pytest.fixture(scope="module")
-def setup():
-    cfg = LlamaConfig.tiny(use_flash=False, dtype=jnp.float32)
-    model = Llama(cfg)
+def setup(tiny_llama_f32):
+    # params from the session-scope canonical build (tests/conftest.py);
+    # every driver test threads them by value (ServeDriver arg / npz
+    # round-trip), so the exact key only has to be consistent within
+    # the fixture — sharing the session init skips a per-module compile
+    cfg, model, params, _ = tiny_llama_f32
     prompts = [
         np.array(jax.random.randint(
             jax.random.key(60 + i), (1, 3 + (i % 4)), 0,
             cfg.vocab_size), dtype=np.int32)
         for i in range(8)
     ]
-    params = jax.jit(model.init)(jax.random.key(2), prompts[0])["params"]
     return cfg, model, params, prompts
 
 

@@ -9,6 +9,7 @@ flags non-`data` crossing axes as RLT306 — the data-across-slices HSDP
 placement audits clean.
 """
 import json
+import os
 import subprocess
 import sys
 
@@ -208,11 +209,17 @@ def test_single_slice_reports_zero_dcn():
 
 
 def test_trace_cli_multislice_json():
+    # hermetic subprocess: the autouse fixture chdirs into a tmp dir,
+    # so pin the repo root for the package import instead of relying on
+    # the runner's cwd/PYTHONPATH
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     out = subprocess.run(
         [sys.executable, "-m", "ray_lightning_tpu", "trace",
          "llama3-8b", "--topo", "2xcpu-4", "--json"],
-        capture_output=True, text=True, timeout=300,
-        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+        capture_output=True, text=True, timeout=300, cwd=repo,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": repo + os.pathsep
+             + os.environ.get("PYTHONPATH", "")})
     assert out.returncode == 0, out.stdout + out.stderr
     r = json.loads(out.stdout)
     assert r["topology"]["n_slices"] == 2

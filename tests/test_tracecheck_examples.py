@@ -8,6 +8,7 @@ weights (liveness, not arithmetic on params alone)."""
 from __future__ import annotations
 
 import json
+import os
 import subprocess
 import sys
 
@@ -20,6 +21,15 @@ from ray_lightning_tpu.analysis.costmodel import parse_topology
 from ray_lightning_tpu.analysis.tracecheck import audit_step
 
 EXAMPLES = sorted(set(_TRACE_BUILDERS) - {"llama3-8b"})
+
+#: subprocess CLI invocations must be hermetic: the autouse fixture
+#: chdirs every test into a tmp dir, so the repo root (package import +
+#: repo-relative example paths) is pinned explicitly rather than
+#: inherited from whatever cwd/PYTHONPATH the runner happened to have.
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_CLI_ENV = {**os.environ, "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": _REPO + os.pathsep + os.environ.get(
+                "PYTHONPATH", "")}
 
 #: the flagship example audits at its BASELINE.json topology; the
 #: data-parallel examples at a small pod slice
@@ -64,8 +74,8 @@ def test_trace_cli_json_llama(tmp_path):
     out = subprocess.run(
         [sys.executable, "-m", "ray_lightning_tpu", "trace",
          "examples/llama_fsdp_example.py", "--topo", "v5p-64", "--json"],
-        capture_output=True, text=True, timeout=300,
-        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=300, cwd=_REPO,
+        env=_CLI_ENV,
     )
     assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
     d = json.loads(out.stdout.strip().splitlines()[-1])
@@ -89,8 +99,8 @@ def test_trace_cli_unknown_target_exits_2():
     out = subprocess.run(
         [sys.executable, "-m", "ray_lightning_tpu", "trace",
          "no_such_example.py", "--json"],
-        capture_output=True, text=True, timeout=120,
-        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=120, cwd=_REPO,
+        env=_CLI_ENV,
     )
     assert out.returncode == 2
     assert "error" in json.loads(out.stdout.strip().splitlines()[-1])
