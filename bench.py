@@ -401,6 +401,56 @@ def _trace_summary() -> dict:
                 f"{type(exc).__name__}: {str(exc)[:200]}"}
 
 
+def _numerics_summary() -> dict:
+    """numcheck static audit (analysis/numcheck.py) of the flagship
+    bench config's traced step: RLT801-805 counts by rule, the
+    precision ledger, and the headline ``low_precision_reductions``
+    (RLT801 narrow accumulations + RLT804 narrow gradient collectives)
+    duplicated at top level for the bench_gate ceiling ratchet — 0
+    since the f32-accumulation fixes, and it may only stay 0. Pure
+    jaxpr work like `_trace_summary`, carried on every JSON line even
+    when the backend is down; a numerics bug emits ``numerics_error``
+    instead, which waives ABSENCE at the gate, never a grown value."""
+    try:
+        from ray_lightning_tpu.analysis.costmodel import topology_for_kind
+        from ray_lightning_tpu.analysis.numcheck import summarize
+        from ray_lightning_tpu.analysis.tracecheck import audit_step
+        from ray_lightning_tpu.models.llama import LlamaModule
+        from ray_lightning_tpu.parallel.strategy import SingleDevice
+
+        # seq can stay small: the accumulation extents RLT801/804
+        # judge are the model's contraction dims, not the sequence
+        cfg = _bench_cfg(use_flash=True, fused_ce=True, seq=512,
+                         vocab=128256, remat=True, scan=True,
+                         ce_chunk_tokens=1024)
+        report = audit_step(
+            LlamaModule(cfg), SingleDevice(),
+            {"tokens": np.zeros((2, 513), np.int32)},
+            topology=topology_for_kind("TPU v5e", 1),
+            label="bench flagship numerics")
+        nc = [f for f in report.findings if f.rule.startswith("RLT8")]
+        s = summarize(nc)
+        lpr = sum(n for rule, n in s["by_rule"].items()
+                  if rule in ("RLT801", "RLT804"))
+        prec = report.precision or {}
+        return {
+            "numerics": {
+                "findings": s["total"],
+                "by_rule": s["by_rule"],
+                "loss_widest_dtype": prec.get("loss_widest_dtype"),
+                "ledger": {k: prec.get(k) for k in
+                           ("params", "opt_state", "activations",
+                            "kv_pool")},
+                "source": "static-trace",
+            },
+            "low_precision_reductions": lpr,
+        }
+    except Exception as exc:  # noqa: BLE001 — advisory data only; a
+        # numerics-audit bug must never cost the bench its perf evidence
+        return {"numerics_error":
+                f"{type(exc).__name__}: {str(exc)[:200]}"}
+
+
 def _multislice_summary() -> dict:
     """Static multi-slice (DCN) trace summary for the bench JSON
     (ISSUE 9): the bench model's HSDP step on a 2xv5p-64 deployment —
@@ -1000,6 +1050,7 @@ def main() -> None:
     _install_kill_handlers()
     _ANALYSIS.update(_concurrency_summary())
     _ANALYSIS.update(_trace_summary())
+    _ANALYSIS.update(_numerics_summary())
     _ANALYSIS.update(_multislice_summary())
     _ANALYSIS.update(_guard_summary())
     _ANALYSIS.update(_telemetry_summary())

@@ -37,8 +37,10 @@ fi
 # races, lock-order inversions, thread leaks, signal-handler and
 # blocking-under-lock discipline) into the same gate — the package's
 # host-side threading is linted as strictly as its jit-side sharding.
+# --numerics adds numcheck's AST arm (inline .astype(bf16/int8)
+# operands in dot/einsum calls — the RLT801/805 copy-paste shapes).
 JAX_PLATFORMS=cpu python -m ray_lightning_tpu lint --concurrency \
-    ray_lightning_tpu examples bench.py __graft_entry__.py
+    --numerics ray_lightning_tpu examples bench.py __graft_entry__.py
 
 # lockwatch smoke (docs/STATIC_ANALYSIS.md "threadcheck & lockwatch"):
 # the runtime half of the concurrency gate. Arm the sanitizer BEFORE
@@ -75,11 +77,50 @@ for _ in range(20):
 assert_lockwatch_clean()
 print("lockwatch smoke: armed, threaded spans clean")'
 
-# tracecheck gate: the flagship Llama-8B v5p-64 step must audit clean at
-# the jaxpr level (no implicit resharding, no ring deadlocks, peak HBM
-# within budget) — docs/STATIC_ANALYSIS.md "tracecheck". CPU-only.
+# tracecheck + numcheck gate: the flagship Llama-8B v5p-64 step must
+# audit clean at the jaxpr level (no implicit resharding, no ring
+# deadlocks, peak HBM within budget — docs/STATIC_ANALYSIS.md
+# "tracecheck") AND numerics-clean: zero RLT8xx findings of ANY
+# severity (the warning-grade cast churn and bf16 transcendentals
+# gate too), an f32 loss widest path, and a populated precision
+# ledger ("numcheck — the precision layer").
 JAX_PLATFORMS=cpu python -m ray_lightning_tpu trace llama3-8b \
-    --topo v5p-64 --json --fail-on error > /dev/null
+    --topo v5p-64 --json --fail-on error | python -c '
+import json, sys
+r = json.load(sys.stdin)
+bad = [f for f in r["findings"] if f["rule"].startswith("RLT8")]
+assert not bad, f"flagship not numerics-clean: {bad}"
+p = r["precision"]
+assert p and p["params"], "precision ledger missing/empty"
+assert p["loss_widest_dtype"] == "float32", \
+    "loss widest path is %r, not f32" % p["loss_widest_dtype"]
+print("numcheck gate: flagship RLT8xx-clean, loss path f32, "
+      "%d param dtype class(es) in ledger" % len(p["params"]))'
+
+# numcheck examples sweep: every bundled example trace target must be
+# free of RLT801 (bf16 accumulation) and RLT805 (scale-free quant
+# consume) — the two rules whose regressions are always real numeric
+# bugs, not style. One process, all targets (import cost paid once).
+# The llama targets are excluded: both resolve to the flagship 8B
+# build the gate above already holds to the STRICTER zero-RLT8xx bar,
+# and tracing it twice would double the slowest gate for no coverage.
+JAX_PLATFORMS=cpu python -c '
+from ray_lightning_tpu.analysis.cli import _TRACE_BUILDERS, \
+    resolve_trace_target
+from ray_lightning_tpu.analysis.costmodel import parse_topology
+from ray_lightning_tpu.analysis.tracecheck import audit_step
+
+targets = sorted(
+    set(_TRACE_BUILDERS) - {"llama3-8b", "llama_fsdp_example.py"})
+topo = parse_topology("v5p-8")
+for target in targets:
+    module, strategy, batch, label = resolve_trace_target(target, topo)
+    rep = audit_step(module, strategy, batch, topology="v5p-8",
+                     label=label)
+    bad = [f for f in rep.findings if f.rule in ("RLT801", "RLT805")]
+    assert not bad, f"{target}: {[f.message for f in bad]}"
+print("numcheck sweep: %d example targets free of RLT801/RLT805"
+      % len(targets))'
 
 # collective-overlap gate (docs/PERFORMANCE.md "collective overlap"):
 # the same flagship step under the strategy's overlap="on" knob must

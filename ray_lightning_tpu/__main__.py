@@ -210,6 +210,8 @@ def _plan_trace_section(args, module_factory, strategy_factory,
             "fits": report.fits,
             "finding_counts": counts,
             "findings": [f.to_dict() for f in report.findings],
+            **({"precision": report.precision}
+               if getattr(args, "precision", False) else {}),
         }
     except Exception as exc:  # noqa: BLE001 — advisory section only
         return {"trace_error": f"{type(exc).__name__}: {str(exc)[:300]}"}
@@ -234,6 +236,28 @@ def _print_trace_section(trace: dict) -> None:
     for f in trace["findings"]:
         print(f"  {f['severity']} {f['rule']} ({f['name']}): "
               f"{f['message']}")
+    _print_precision_ledger(trace.get("precision"))
+
+
+def _print_precision_ledger(prec) -> None:
+    """``plan --precision``: the per-dtype-class byte ledger numcheck
+    fills on every TraceReport (analysis/numcheck.py)."""
+    if not prec:
+        return
+    mib = 1024**2
+
+    def _cls(name):
+        by = prec.get(name) or {}
+        if not by:
+            return "-"
+        return ", ".join(f"{dt} {b / mib:.1f} MiB"
+                         for dt, b in sorted(by.items(),
+                                             key=lambda kv: -kv[1]))
+    print("  precision ledger (per device):")
+    for name in ("params", "opt_state", "activations", "kv_pool"):
+        print(f"    {name:<12} {_cls(name)}")
+    print(f"    loss widest-path dtype: "
+          f"{prec.get('loss_widest_dtype') or 'n/a'}")
 
 
 def _run_serve_plan(args) -> int:
@@ -296,6 +320,8 @@ def _run_serve_plan(args) -> int:
                 "peak_hbm_bytes": report.peak_hbm_bytes,
                 "hbm_budget_bytes": report.hbm_budget_bytes,
                 "findings": [f.to_dict() for f in report.findings],
+                **({"precision": report.precision}
+                   if getattr(args, "precision", False) else {}),
             }
         except Exception as exc:  # noqa: BLE001 — advisory section only
             trace = {"trace_error":
@@ -317,6 +343,7 @@ def _run_serve_plan(args) -> int:
                       f"{trace['peak_hbm_bytes'] / gib:.2f} GiB vs "
                       f"budget {trace['hbm_budget_bytes'] / gib:.2f} "
                       f"GiB; findings: {rules if rules else 'none'}")
+                _print_precision_ledger(trace.get("precision"))
     return 0 if summary["fits"] else 1
 
 
@@ -549,6 +576,12 @@ def main(argv=None) -> int:
                         help="skip the tracecheck section (the "
                              "jaxpr-level collective/HBM audit of the "
                              "planned step)")
+    plan_p.add_argument("--precision", action="store_true",
+                        help="include numcheck's precision ledger in "
+                             "the trace section: per-dtype bytes for "
+                             "params / opt state / activations / KV "
+                             "pool and the loss's widest-path dtype "
+                             "(docs/STATIC_ANALYSIS.md)")
     from ray_lightning_tpu.analysis.cli import (
         add_lint_parser, add_trace_parser, run_lint, run_trace,
     )

@@ -65,6 +65,18 @@ def add_lint_parser(sub) -> None:
     p.add_argument(
         "--no-concurrency", action="store_false", dest="concurrency",
         help="skip threadcheck even on a package self-lint")
+    p.add_argument(
+        "--numerics", action="store_true", dest="numerics",
+        default=None,
+        help="also run numcheck's static pass (RLT801/805: inline "
+             ".astype(bf16)/.astype(int8) operands pushed into dot/"
+             "einsum calls). Default: on when linting the installed "
+             "package (self-lint), off for explicit targets; the full "
+             "dtype-provenance audit lives in `trace`")
+    p.add_argument(
+        "--no-numerics", action="store_false", dest="numerics",
+        help="skip the static numerics pass even on a package "
+             "self-lint")
     # same namespace-sharing contract as the plan subparser: a plain
     # default would clobber a `--json` given before the subcommand
     p.add_argument("--json", action="store_true", dest="as_json",
@@ -139,6 +151,17 @@ def run_lint(args) -> int:
 
         all_findings = list(all_findings) + list(
             check_concurrency_paths(files))
+    # numcheck's static mini-pass rides along under the same tri-state
+    numerics = getattr(args, "numerics", None)
+    if numerics is None:
+        numerics = not args.targets
+    if numerics:
+        from ray_lightning_tpu.analysis.numcheck import (
+            check_numerics_paths,
+        )
+
+        all_findings = list(all_findings) + list(
+            check_numerics_paths(files))
     findings = [
         f for f in all_findings
         if f.rule not in disabled and SEVERITY_RANK[f.severity] >= min_rank
@@ -322,6 +345,14 @@ def add_trace_parser(sub) -> None:
         help="exit 1 when any finding is at/above this severity")
     p.add_argument("--disable", default="",
                    help="comma-separated rule ids to drop (e.g. RLT302)")
+    p.add_argument(
+        "--numerics", action="store_true", dest="numerics", default=True,
+        help="run numcheck's dtype-provenance pass over the traced "
+             "jaxpr (RLT801-805) and report the precision ledger "
+             "(default: on)")
+    p.add_argument(
+        "--no-numerics", action="store_false", dest="numerics",
+        help="skip the numerics pass and the precision ledger")
     # same namespace-sharing contract as the plan/lint subparsers
     p.add_argument("--json", action="store_true", dest="as_json",
                    default=argparse.SUPPRESS)
@@ -390,7 +421,8 @@ def run_trace(args) -> int:
     module, strategy, batch, label = built
 
     report = audit_step(module, strategy, batch, topology=topo,
-                        label=label)
+                        label=label,
+                        numerics=getattr(args, "numerics", True))
     disabled = {r.strip() for r in args.disable.split(",") if r.strip()}
     min_rank = SEVERITY_RANK[args.severity]
     findings = [f for f in report.findings

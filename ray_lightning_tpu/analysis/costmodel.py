@@ -51,10 +51,44 @@ from ray_lightning_tpu.parallel.plan import hbm_bytes_for_kind
 
 __all__ = [
     "Topology", "CollectiveCost", "ICI_SPECS", "DCN_SPECS",
-    "MXU_EFFICIENCY", "parse_topology", "topology_for_kind",
+    "MXU_EFFICIENCY", "DTYPE_WIDTHS", "dtype_width",
+    "parse_topology", "topology_for_kind",
     "collective_cost", "compute_time_us",
     "paged_decode_traffic_bytes", "paged_prefill_traffic_bytes",
 ]
+
+#: canonical storage width in BYTES per dtype name — the ONE table both
+#: plan_checker's RLT105 (opt state wider than its param) and numcheck's
+#: RLT804 (gradient collective narrower than its opt state) read, so the
+#: two rules cannot drift (tests/test_numcheck.py pins this). Names are
+#: the `str(np.dtype)` / jax aval spellings the analyzers see; the jax
+#: sub-byte int4/uint4 and the fp8 family are listed explicitly because
+#: np.dtype() cannot resolve them everywhere.
+DTYPE_WIDTHS: Dict[str, float] = {
+    "float64": 8.0, "int64": 8.0, "uint64": 8.0, "complex64": 8.0,
+    "float32": 4.0, "int32": 4.0, "uint32": 4.0,
+    "bfloat16": 2.0, "float16": 2.0, "int16": 2.0, "uint16": 2.0,
+    "float8_e4m3fn": 1.0, "float8_e5m2": 1.0, "float8_e4m3b11fnuz": 1.0,
+    "int8": 1.0, "uint8": 1.0, "bool": 1.0,
+    "int4": 0.5, "uint4": 0.5,
+}
+
+
+def dtype_width(dtype) -> Optional[float]:
+    """Storage width in bytes for a dtype (object or name); None when
+    unknown. Falls back to numpy's itemsize for names not in the table
+    (exotic structured dtypes) so callers degrade to the historical
+    `.itemsize` behavior instead of silently skipping the check."""
+    name = getattr(dtype, "name", None) or str(dtype)
+    w = DTYPE_WIDTHS.get(name)
+    if w is not None:
+        return w
+    try:
+        import numpy as np
+
+        return float(np.dtype(name).itemsize)
+    except Exception:
+        return None
 
 #: ICI spec sheet per device family: (device_kind for the HBM table,
 #: aggregate ICI GB/s per chip, per-hop latency in microseconds).

@@ -248,6 +248,45 @@ RULES: Dict[str, Rule] = {r.id: r for r in (
          "EVERY critical section is the same I/O (a dedicated "
          "append-serialization lock) is sanctioned — the hazard is a "
          "lock that also guards in-memory state"),
+    # RLT8xx — numcheck (analysis/numcheck.py): jaxpr-level mixed-
+    # precision flow audit. The dtype model and every sanction are
+    # documented in docs/STATIC_ANALYSIS.md "numcheck — the precision
+    # layer"; RLT805 is the contract the int8-KV campaign (ROADMAP
+    # item 2c) compiles against.
+    Rule("RLT801", "low-precision-accumulation", "error",
+         "a dot_general or reduce-sum accumulates in bf16/f16 over a "
+         "large contraction extent (missing "
+         "preferred_element_type=f32): each bf16 add keeps 8 mantissa "
+         "bits, so a K-term sum loses ~log2(K) of them — at K=4096 "
+         "half the mantissa is noise. Small extents are sanctioned "
+         "(the error is bounded by the extent)"),
+    Rule("RLT802", "unstable-primitive-in-low-precision", "warning",
+         "exp/log/rsqrt (the softmax/logsumexp/variance building "
+         "blocks) computed on a bf16/f16 value with no f32 upcast: "
+         "exp overflows bf16 at x>88 unless the operand is max-"
+         "subtracted (sub-max inputs are sanctioned), log/rsqrt lose "
+         "their low-order bits exactly where the result is largest. "
+         "The pallas kernels' f32 scratch is sanctioned by "
+         "construction (their operands are already f32)"),
+    Rule("RLT803", "cast-churn", "warning",
+         "an f32 value is rounded to bf16/f16 and converted straight "
+         "back to f32 with no compute in between (only layout ops or "
+         "a scan carry boundary): the round trip buys nothing, costs "
+         "a rounding, and writes both copies through HBM"),
+    Rule("RLT804", "low-precision-gradient-collective", "error",
+         "a gradient psum/reduce_scatter runs on a bf16/f16 payload "
+         "whose optimizer state is stored wider (f32): the ring "
+         "reduction accumulates in the wire dtype, so the N-shard sum "
+         "loses precision BEFORE the optimizer ever sees it — widen "
+         "the gradient (preferred_element_type=f32 on the backward "
+         "matmuls) so the reduction rides f32"),
+    Rule("RLT805", "quant-contract", "error",
+         "an int8/int4-origin value is consumed by float arithmetic "
+         "with no dequantization scale applied (no multiply by an "
+         "f32 scale between the int load and the math), or its scale "
+         "is itself narrower than f32: the quantized payload is "
+         "garbage without its scale, and a bf16 scale re-quantizes "
+         "the error the int8 encoding already paid for"),
 )}
 
 

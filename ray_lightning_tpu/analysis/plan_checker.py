@@ -143,8 +143,13 @@ def check_opt_state_dtypes(named_params: Mapping[str, Any],
         if match is None:
             continue
         ppath, pleaf = match
-        p_size = getattr(pleaf.dtype, "itemsize", None)
-        o_size = getattr(odtype, "itemsize", None)
+        # widths come from the SHARED table (costmodel.DTYPE_WIDTHS) so
+        # this rule and numcheck's RLT804 judge "wider" identically —
+        # tests/test_numcheck.py pins the two against each other
+        from ray_lightning_tpu.analysis.costmodel import dtype_width
+
+        p_size = dtype_width(getattr(pleaf, "dtype", None))
+        o_size = dtype_width(odtype)
         if p_size and o_size and o_size > p_size:
             findings.append(Finding(
                 "RLT105",

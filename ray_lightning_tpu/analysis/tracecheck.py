@@ -183,6 +183,12 @@ class CollectiveEvent:
     #: slices. ``wire_bytes`` stays the ICI tier; ``time_us`` includes
     #: both tiers (costmodel.collective_cost).
     dcn_bytes: int = 0
+    #: payload dtype name ("bfloat16"/"float32"/...), when the walk
+    #: could see it — numcheck's RLT804 judges gradient reductions over
+    #: this field (the GSPMD-inserted grad psum/reduce_scatter exists
+    #: only as an event, never as a jaxpr eqn). None on synthetic or
+    #: pre-dtype-threading events.
+    dtype: Optional[str] = None
 
     @property
     def exposed_us(self) -> float:
@@ -198,9 +204,10 @@ class CollectiveEvent:
         who = f"  <{self.param_path}>" if self.param_path else ""
         dcn = (f" +{_fmt_bytes(self.dcn_bytes).strip()} DCN"
                if self.dcn_bytes else "")
+        dt = f" {self.dtype}" if self.dtype else ""
         return (f"{self.kind:<14} axes={','.join(self.axes) or '-'} "
                 f"x{self.count:<4} {_fmt_bytes(self.wire_bytes)} wire"
-                f"{dcn} {self.time_us:9.1f} us  [{tag}{extra}] "
+                f"{dcn}{dt} {self.time_us:9.1f} us  [{tag}{extra}] "
                 f"{self.source}{who}")
 
 
@@ -212,6 +219,11 @@ def _pallas_kernel_ident(eqn) -> str:
     ident = (eqn.params.get("name_and_src_info")
              or eqn.params.get("name") or "pallas")
     return str(ident)
+
+
+def _aval_dtype(aval) -> Optional[str]:
+    dt = getattr(aval, "dtype", None)
+    return str(dt) if dt is not None else None
 
 
 def _fmt_bytes(n: float) -> str:
@@ -245,6 +257,14 @@ class TraceReport:
     #: — the serve audit's "which attention path does this step run"
     #: evidence (empty on pure-XLA programs)
     pallas_kernels: List[str] = dataclasses.field(default_factory=list)
+    #: numcheck's precision ledger: per-dtype-class byte itemization
+    #: ({"params": {dtype: bytes}, "opt_state": {...},
+    #: "activations": {...}, "kv_pool": {...}} — sub-jaxpr scratch is
+    #: folded into activations per dtype by the walk's `_sub_by`
+    #: threading) plus "loss_widest_dtype", the widest float dtype on
+    #: the loss output's provenance path. None when the audit ran with
+    #: numerics off.
+    precision: Optional[Dict[str, Any]] = None
 
     @property
     def ici_bytes_per_step(self) -> int:
@@ -340,6 +360,19 @@ class TraceReport:
             "intermediates) vs budget "
             f"{self.hbm_budget_bytes / gib:.2f} GiB — "
             f"{'FITS' if self.fits else 'DOES NOT FIT'}")
+        if self.precision:
+            lines.append("precision ledger (per device):")
+            for cls in ("params", "opt_state", "activations", "kv_pool"):
+                by = self.precision.get(cls) or {}
+                if not by:
+                    continue
+                parts = " + ".join(
+                    f"{dt} {b / gib:.3f} GiB"
+                    for dt, b in sorted(by.items(), key=lambda kv: -kv[1]))
+                lines.append(f"  {cls:<12}: {parts}")
+            widest = self.precision.get("loss_widest_dtype")
+            if widest:
+                lines.append(f"  loss widest-path dtype: {widest}")
         if self.findings:
             lines.append(f"findings ({len(self.findings)}):")
             lines.extend("  " + f.format() for f in self.findings)
@@ -376,7 +409,7 @@ class TraceReport:
                  "source": e.source, "param_path": e.param_path,
                  "unbounded": e.unbounded,
                  "prefetchable": e.prefetchable, "scope": e.scope,
-                 "hidden_us": round(e.hidden_us, 1)}
+                 "hidden_us": round(e.hidden_us, 1), "dtype": e.dtype}
                 for e in sorted(self.collectives,
                                 key=lambda e: -e.wire_bytes)
             ],
@@ -387,6 +420,7 @@ class TraceReport:
             "hbm_budget_bytes": self.hbm_budget_bytes,
             "fits": self.fits,
             "pallas_kernels": list(self.pallas_kernels),
+            "precision": self.precision,
             "findings": [f.to_dict() for f in self.findings],
         }
 
@@ -504,6 +538,12 @@ class _StepAuditor:
         #: fingerprint-over-reimplementation discipline as the flash
         #: remat tag
         self.pallas_kernels: List[str] = []
+        #: per-dtype byte breakdown of the LAST sub-jaxpr walk, set by
+        #: _seed_and_walk and read by the enclosing walk() when it
+        #: snapshots a new liveness peak — the plumbing that lets the
+        #: precision ledger keep `sum(peak_by) == peak` exact through
+        #: nested scan/pjit/cond scratch
+        self._sub_by: Dict[str, int] = {}
 
     # ---- bookkeeping ----------------------------------------------------
 
@@ -552,7 +592,8 @@ class _StepAuditor:
     def record(self, kind: str, payload: int, axes: Sequence[str],
                mult: int, *, implicit: bool, source: str,
                param_path: Optional[str] = None,
-               prefetchable: bool = False) -> None:
+               prefetchable: bool = False,
+               dtype: Optional[str] = None) -> None:
         if self._quiet or not axes:
             return
         group = {ax: self.sizes.get(ax, 1) for ax in axes}
@@ -564,7 +605,7 @@ class _StepAuditor:
             dcn_group=self._dcn_span(axes))
         scope = self._scope_stack[-1] if self._scope_stack else None
         key = (kind, tuple(sorted(axes)), payload, source, implicit,
-               bool(self._unbounded), scope, prefetchable)
+               bool(self._unbounded), scope, prefetchable, dtype)
         ev = self._events.get(key)
         if ev is None:
             self._events[key] = CollectiveEvent(
@@ -574,7 +615,7 @@ class _StepAuditor:
                 source=source, param_path=param_path,
                 unbounded=bool(self._unbounded),
                 prefetchable=prefetchable, scope=scope,
-                dcn_bytes=cost.dcn_bytes * mult)
+                dcn_bytes=cost.dcn_bytes * mult, dtype=dtype)
         else:
             ev.count += mult
             ev.wire_bytes += cost.wire_bytes * mult
@@ -646,7 +687,7 @@ class _StepAuditor:
         payload = self._aval_bytes(aval, remaining)
         self.record("all_gather", payload, sorted(axes), mult,
                     implicit=True, source=source, param_path=info.path,
-                    prefetchable=info.param)
+                    prefetchable=info.param, dtype=_aval_dtype(aval))
         if not info.param:
             self.flag(
                 "RLT301",
@@ -764,19 +805,26 @@ class _StepAuditor:
             payload = self._aval_bytes(out_aval, tuple(out_spec))
             self.record("reduce_scatter", payload, sorted(partial),
                         mult, implicit=True, source=source,
-                        param_path=mpath or path, prefetchable=True)
+                        param_path=mpath or path, prefetchable=True,
+                        dtype=_aval_dtype(out_aval))
             return tuple(s | m for s, m in zip(out_spec, mspec))
         payload = self._aval_bytes(out_aval, tuple(out_spec))
         self.record("psum", payload, sorted(partial), mult,
-                    implicit=True, source=source, param_path=path)
+                    implicit=True, source=source, param_path=path,
+                    dtype=_aval_dtype(out_aval))
         return tuple(out_spec)
 
     # ---- the walk -------------------------------------------------------
 
-    def walk(self, jaxpr, env: Dict, mult: int, manual: bool) -> int:
+    def walk(self, jaxpr, env: Dict, mult: int,
+             manual: bool) -> Tuple[int, Dict[str, int]]:
         """Propagate shardings through ``jaxpr`` (env maps Var ->
         _VarInfo; invars must be seeded), record events/findings, and
-        return the liveness peak in per-device bytes."""
+        return ``(peak, peak_by)``: the liveness peak in per-device
+        bytes plus its per-dtype itemization (the precision ledger's
+        raw material — ``sum(peak_by.values()) == peak`` by
+        construction, with nested sub-jaxpr scratch folded in through
+        ``self._sub_by``)."""
         eqns = jaxpr.eqns
         last: Dict[Any, int] = {}
         for i, eqn in enumerate(eqns):
@@ -793,9 +841,19 @@ class _StepAuditor:
             info = env.get(v)
             return self._aval_bytes(v.aval, info.spec if info else None)
 
-        live = sum(vb(v) for v in {*jaxpr.invars, *jaxpr.constvars})
+        def vdt(v) -> str:
+            return _aval_dtype(getattr(v, "aval", None)) or "opaque"
+
+        live_by: Dict[str, int] = {}
+        for v in {*jaxpr.invars, *jaxpr.constvars}:
+            b = vb(v)
+            if b:
+                live_by[vdt(v)] = live_by.get(vdt(v), 0) + b
+        live = sum(live_by.values())
         peak = live
+        peak_by = dict(live_by)
         for i, eqn in enumerate(eqns):
+            self._sub_by = {}
             try:
                 sub_peak = self._process(eqn, env, mult, manual)
             except Exception:  # noqa: BLE001 — propagation must degrade,
@@ -803,22 +861,40 @@ class _StepAuditor:
                 for v in eqn.outvars:
                     env[v] = _VarInfo(None)
                 sub_peak = 0
+                self._sub_by = {}
             for v in eqn.outvars:  # values defined HERE are born at the
                 info = env.get(v)  # current loop multiplier
                 if info is not None:
                     info.born_mult = mult
             out_b = sum(vb(v) for v in eqn.outvars)
-            peak = max(peak, live + (sub_peak or 0) + out_b)
+            if live + (sub_peak or 0) + out_b > peak:
+                peak = live + (sub_peak or 0) + out_b
+                peak_by = dict(live_by)
+                for v in eqn.outvars:
+                    b = vb(v)
+                    if b:
+                        peak_by[vdt(v)] = peak_by.get(vdt(v), 0) + b
+                for dt, b in self._sub_by.items():
+                    if b:
+                        peak_by[dt] = peak_by.get(dt, 0) + b
             live += out_b
+            for v in eqn.outvars:
+                b = vb(v)
+                if b:
+                    live_by[vdt(v)] = live_by.get(vdt(v), 0) + b
             for v in {v for v in eqn.invars if hasattr(v, "count")}:
                 if last.get(v) == i:
-                    live -= vb(v)
-        return peak
+                    b = vb(v)
+                    if b:
+                        live -= b
+                        live_by[vdt(v)] = live_by.get(vdt(v), 0) - b
+        return peak, peak_by
 
     def _seed_and_walk(self, closed_or_open, outer_invars, env, mult,
                        manual) -> Tuple[int, List[_VarInfo]]:
         """Map outer invar infos onto a sub-jaxpr, walk it, return
-        (peak, outvar infos)."""
+        (peak, outvar infos). The inner walk's per-dtype breakdown is
+        left on ``self._sub_by`` for the enclosing walk's snapshot."""
         inner = getattr(closed_or_open, "jaxpr", closed_or_open)
         sub_env: Dict = {}
         for iv, ov in zip(inner.invars, outer_invars):
@@ -827,7 +903,8 @@ class _StepAuditor:
         for cv in inner.constvars:
             sub_env[cv] = _VarInfo(
                 _repl(len(getattr(cv.aval, "shape", ()))), param=True)
-        sub_peak = self.walk(inner, sub_env, mult, manual)
+        sub_peak, sub_by = self.walk(inner, sub_env, mult, manual)
+        self._sub_by = sub_by
         outs = [self._info(v, sub_env) for v in inner.outvars]
         return sub_peak, outs
 
@@ -989,6 +1066,10 @@ class _StepAuditor:
                     self._seed_and_walk(closed, infos, env, mult, manual)
                 except Exception:  # noqa: BLE001 — recognition is
                     pass           # best-effort, never aborts the audit
+                # kernel buffers are VMEM: the recursive walk was for
+                # recognition only, its bytes must not leak into the
+                # enclosing HBM snapshot (sub_peak stays 0)
+                self._sub_by = {}
             set_all([self._like_shaped_input(v, infos, avals)
                      for v in out])
         elif name == "gather":
@@ -1436,7 +1517,7 @@ class _StepAuditor:
     def _cond(self, eqn, infos, env, mult, manual, src) -> int:
         branches = eqn.params["branches"]
         ops = infos[1:]
-        peaks, outs_by_branch, sigs = [], [], []
+        peaks, bys, outs_by_branch, sigs = [], [], [], []
         for bi, br in enumerate(branches):
             if bi > 0:
                 self._quiet += 1
@@ -1446,6 +1527,7 @@ class _StepAuditor:
                 if bi > 0:
                     self._quiet -= 1
             peaks.append(pk)
+            bys.append(self._sub_by)
             outs_by_branch.append(outs)
             sigs.append(_collective_signature(
                 getattr(br, "jaxpr", br)))
@@ -1465,7 +1547,13 @@ class _StepAuditor:
             merged.append(m)
         for v, info in zip(eqn.outvars, merged):
             env[v] = info
-        return max(peaks) if peaks else 0
+        if not peaks:
+            return 0
+        # the returned peak is the widest branch's: its per-dtype
+        # breakdown must ride along or sum(peak_by) drifts off peak
+        widest = max(range(len(peaks)), key=peaks.__getitem__)
+        self._sub_by = bys[widest]
+        return peaks[widest]
 
     def _shard_map(self, eqn, infos, env, mult) -> int:
         inner = eqn.params["jaxpr"]
@@ -1481,7 +1569,7 @@ class _StepAuditor:
         for cv in inner.constvars:
             sub_env[cv] = _VarInfo(
                 _repl(len(getattr(cv.aval, "shape", ()))), param=True)
-        sub_peak = self.walk(inner, sub_env, mult, True)
+        sub_peak, self._sub_by = self.walk(inner, sub_env, mult, True)
         for v, names in zip(eqn.outvars, out_names):
             ndim = len(getattr(v.aval, "shape", ()))
             spec = [frozenset() for _ in range(ndim)]
@@ -1508,7 +1596,8 @@ class _StepAuditor:
             payload = sum(self._aval_bytes(a) for a in avals
                           if a is not None)
             self.record("ppermute", payload, axes, mult, implicit=False,
-                        source=src, param_path=path)
+                        source=src, param_path=path,
+                        dtype=_aval_dtype(avals[0] if avals else None))
             return
         if name == "all_gather":
             payload = sum(self._aval_bytes(v.aval) for v in eqn.outvars)
@@ -1518,7 +1607,8 @@ class _StepAuditor:
         kind = {"pmax": "psum", "pmin": "psum",
                 "pbroadcast": "psum"}.get(name, name)
         self.record(kind, payload, axes, mult, implicit=False,
-                    source=src, param_path=path)
+                    source=src, param_path=path,
+                    dtype=_aval_dtype(avals[0] if avals else None))
 
 
 def _reshape_spec(in_shape: Tuple[int, ...],
@@ -1756,12 +1846,18 @@ def audit_step(
     n_devices: Optional[int] = None,
     reserve_fraction: float = 0.10,
     label: str = "",
+    numerics: bool = True,
 ) -> TraceReport:
     """Full tracecheck audit: trace the real jitted step for ``module``
     under ``strategy`` on ``topology`` (a name like "v5p-64" or a
     `costmodel.Topology`) and return the `TraceReport` — collective
     schedule, implicit-reshard findings, ring checks, and the peak-HBM
-    estimate vs the chip budget. CPU-only; consumes ``strategy``."""
+    estimate vs the chip budget. CPU-only; consumes ``strategy``.
+
+    ``numerics`` runs numcheck's dtype-provenance pass over the same
+    jaxpr (RLT801-805) and fills `TraceReport.precision` — the
+    per-dtype-class byte ledger plus the loss's widest-path dtype;
+    ``numerics=False`` (the CLI's ``--no-numerics``) skips both."""
     import jax
 
     topo = (topology if isinstance(topology, Topology)
@@ -1831,15 +1927,25 @@ def audit_step(
         env[v] = _VarInfo(_repl(len(getattr(v.aval, "shape", ()))),
                           param=True)
 
-    peak = auditor.walk(jaxpr, env, 1, False)
+    peak, peak_by = auditor.walk(jaxpr, env, 1, False)
 
-    params_dev = sum(
-        auditor._aval_bytes(leaf, s.spec)
-        for (_, leaf), s in zip(meta["named_params"].items(), seeds))
+    def _by_dtype(named, seed_slice) -> Dict[str, int]:
+        # per-dtype itemization of the SAME per-leaf bytes the scalar
+        # totals sum — the ledger identity sum(by.values()) == total
+        # holds exactly (test-pinned)
+        by: Dict[str, int] = {}
+        for (_, leaf), s in zip(named.items(), seed_slice):
+            b = auditor._aval_bytes(leaf, s.spec)
+            if b:
+                dt = str(getattr(leaf, "dtype", "opaque"))
+                by[dt] = by.get(dt, 0) + b
+        return by
+
+    params_by = _by_dtype(meta["named_params"], seeds)
+    params_dev = sum(params_by.values())
     np_ = len(meta["named_params"])
-    opt_dev = sum(
-        auditor._aval_bytes(leaf, s.spec)
-        for (_, leaf), s in zip(meta["named_opt"].items(), seeds[np_:]))
+    opt_by = _by_dtype(meta["named_opt"], seeds[np_:])
+    opt_dev = sum(opt_by.values())
 
     events = auditor.events
     overlap = classify_overlap(events, auditor.scopes, topo,
@@ -1904,6 +2010,35 @@ def audit_step(
                     f"behind the previous layer's compute [at "
                     f"{e.source}]",
                     symbol=e.param_path or e.source))
+    precision: Optional[Dict[str, Any]] = None
+    if numerics:
+        from ray_lightning_tpu.analysis import numcheck as _numcheck
+
+        # outvar layout of the canonical step: new-param leaves, then
+        # new-opt leaves, then the scalar loss, then metrics — the loss
+        # output sits right past the state
+        loss_index = np_ + len(meta["named_opt"])
+        nc_findings, nc_info = _numcheck.numcheck_jaxpr(
+            closed, loss_index=loss_index)
+        findings.extend(nc_findings)
+        findings.extend(_numcheck.check_gradient_collectives(
+            events, meta["named_params"], meta["named_opt"]))
+        # activations = what the liveness peak holds per dtype beyond
+        # the resident params/opt state (clamped: state leaves already
+        # freed at the peak instant don't go negative)
+        act_by: Dict[str, int] = {}
+        for dt, b in peak_by.items():
+            rem = b - params_by.get(dt, 0) - opt_by.get(dt, 0)
+            if rem > 0:
+                act_by[dt] = rem
+        precision = {
+            "params": params_by,
+            "opt_state": opt_by,
+            "activations": act_by,
+            "kv_pool": {},
+            "loss_widest_dtype": nc_info.get("loss_widest_dtype"),
+        }
+
     budget = int(topo.hbm_bytes * (1 - reserve_fraction))
     if peak > budget:
         gib = 1024**3
@@ -1925,4 +2060,5 @@ def audit_step(
         peak_hbm_bytes=peak,
         hbm_budget_bytes=budget,
         label=label,
+        precision=precision,
     )

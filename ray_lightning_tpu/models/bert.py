@@ -25,6 +25,7 @@ from jax.sharding import PartitionSpec as P
 
 from ray_lightning_tpu.core.module import TpuModule
 from ray_lightning_tpu.ops.attention import flash_attention
+from ray_lightning_tpu.ops.precision import F32AccDense
 
 
 @dataclasses.dataclass(frozen=True)
@@ -62,7 +63,13 @@ class BertLayer(nn.Module):
     @nn.compact
     def __call__(self, x, mask, deterministic: bool):
         cfg = self.cfg
-        dense = partial(nn.Dense, dtype=cfg.dtype, param_dtype=jnp.float32)
+        # f32-accumulating dense (ops/precision.py): bf16 operands at
+        # full MXU rate, f32 dot accumulator AND f32 bias add, one
+        # rounding — so the backward bias grad (a token-extent
+        # reduce_sum) and the grad collectives run at f32 (numcheck
+        # RLT801/RLT804); at dtype=f32 it is bitwise nn.Dense, so HF
+        # parity is untouched
+        dense = partial(F32AccDense, dtype=cfg.dtype)
         ln = partial(nn.LayerNorm, epsilon=cfg.norm_eps, dtype=cfg.dtype,
                      param_dtype=jnp.float32)
         B, S, _ = x.shape
@@ -81,9 +88,13 @@ class BertLayer(nn.Module):
         attn = nn.Dropout(cfg.dropout)(attn, deterministic=deterministic)
         x = ln(name="attn_ln")(x + attn)
 
-        # exact (erf) GELU — what HF BERT checkpoints were trained with
-        h = nn.gelu(dense(cfg.hidden_dim, name="w_up")(x), approximate=False)
-        h = dense(cfg.dim, name="w_down")(h)
+        # exact (erf) GELU — what HF BERT checkpoints were trained with.
+        # Computed at f32: erf's backward is exp(-x^2), which numcheck
+        # (RLT802) rightly refuses to see on a bf16 operand — and erf
+        # itself lives on low-order bits bf16 has already rounded away
+        h = nn.gelu(dense(cfg.hidden_dim, name="w_up")(x)
+                    .astype(jnp.float32), approximate=False)
+        h = dense(cfg.dim, name="w_down")(h.astype(cfg.dtype))
         h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
         x = ln(name="mlp_ln")(x + h)
         return x, None
@@ -140,10 +151,15 @@ class BertForSequenceClassification(nn.Module):
                  deterministic: bool = True):
         x = BertEncoder(self.cfg, name="encoder")(
             input_ids, attention_mask, token_type_ids, deterministic)
-        # BERT pooler: tanh-projected [CLS]
-        pooled = nn.tanh(nn.Dense(self.cfg.dim, dtype=jnp.float32,
-                                  param_dtype=jnp.float32,
-                                  name="pooler")(x[:, 0].astype(jnp.float32)))
+        # BERT pooler: tanh-projected [CLS], consumed at the encoder's
+        # activation dtype. Re-widening the final LayerNorm's rounded
+        # bf16 output to f32 here would be a pure f32->bf16->f32 round
+        # trip (numcheck RLT803) — instead the dense accumulates at f32
+        # from bf16 operands (ops/precision.py) and only the bounded
+        # tanh input is widened; at dtype=f32 this is bitwise nn.Dense
+        pooled = nn.tanh(F32AccDense(self.cfg.dim, dtype=self.cfg.dtype,
+                                     name="pooler")(x[:, 0])
+                         .astype(jnp.float32))
         pooled = nn.Dropout(self.cfg.dropout)(pooled,
                                               deterministic=deterministic)
         return nn.Dense(self.num_classes, dtype=jnp.float32,

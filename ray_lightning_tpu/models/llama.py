@@ -42,6 +42,14 @@ from ray_lightning_tpu.ops.norms import rms_norm
 from ray_lightning_tpu.ops.rope import apply_rope, rope_frequencies
 
 
+# f32-accumulating dense dots (numcheck RLT801's sanctioned
+# single-rounding shape; see ops/precision.py for the full contract)
+from ray_lightning_tpu.ops.precision import (
+    f32_acc_dot_general as _f32_acc_dot_general,
+    f32_out_dot_general as _f32_out_dot_general,
+)
+
+
 @dataclasses.dataclass(frozen=True)
 class LlamaConfig:
     vocab_size: int = 128256
@@ -252,7 +260,8 @@ class LlamaBlock(nn.Module):
         cfg = self.cfg
         d, hd = cfg.dim, cfg.head_dim
         dense = partial(nn.Dense, use_bias=False, dtype=cfg.dtype,
-                        param_dtype=jnp.float32)
+                        param_dtype=jnp.float32,
+                        dot_general=_f32_acc_dot_general)
 
         attn_norm_w = self.param("attn_norm", nn.initializers.ones, (d,))
         h = rms_norm(x, attn_norm_w, cfg.norm_eps)
@@ -442,11 +451,18 @@ class Llama(nn.Module):
         pool — cache leaves are then [L, n_blocks, P, Hkv, hd] and
         ``pos`` is a per-slot vector; see `LlamaBlock.__call__`."""
         cfg = self.cfg
+        # take from the f32 table and round the (token-sized) result,
+        # rather than dtype=cfg.dtype (which rounds the TABLE before the
+        # take): gather commutes with rounding so the forward is
+        # bitwise identical, but the backward now upcasts per-token
+        # cotangents BEFORE the vocab-sized scatter-add, so the
+        # embedding grad accumulates — and reduce-scatters — in f32
+        # (numcheck RLT804) instead of bf16
         embed = nn.Embed(
-            cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
+            cfg.vocab_size, cfg.dim, dtype=jnp.float32,
             param_dtype=jnp.float32, name="tok_embed",
         )
-        x = embed(tokens)
+        x = embed(tokens).astype(cfg.dtype)
         cos, sin = rope_frequencies(
             cfg.head_dim, cfg.max_seq_len, cfg.rope_theta, dtype=jnp.float32
         )
@@ -504,11 +520,14 @@ class Llama(nn.Module):
         if cfg.tie_embeddings:
             logits = embed.attend(x.astype(jnp.float32))
         else:
-            # vocab projection at activation dtype (bf16 hits the MXU at
-            # full rate; ~3% step-time win); loss math upcasts to f32.
+            # vocab projection at activation dtype (bf16 operands hit
+            # the MXU at full rate; ~3% step-time win) with an f32
+            # accumulator the logits keep — loss/sampling math runs on
+            # the unrounded sum (_f32_out_dot_general).
             logits = nn.Dense(
                 cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                 param_dtype=jnp.float32, name="lm_head",
+                dot_general=_f32_out_dot_general,
             )(x).astype(jnp.float32)
         if cache is None:
             return logits
@@ -1052,14 +1071,16 @@ class LlamaModule(TpuModule):
                 inline_backward=cfg.ce_inline_bwd,
             )
         # materialized logits from the pipelined hidden states — the same
-        # math the flax head performs: cfg.dtype matmul (Embed.attend
-        # promotes to cfg.dtype for tied weights too), f32 loss upcast
+        # math the flax head performs: cfg.dtype operands with the f32
+        # accumulator kept for the loss (_f32_out_dot_general's
+        # contract; a plain cfg.dtype @ here is numcheck's RLT801)
         if cfg.tie_embeddings:
             w = params["tok_embed"]["embedding"].T
         else:
             w = params["lm_head"]["kernel"]
-        logits = (hidden.astype(cfg.dtype) @ w.astype(cfg.dtype)
-                  ).astype(jnp.float32)
+        logits = _f32_out_dot_general(
+            hidden.astype(cfg.dtype), w.astype(cfg.dtype),
+            (((hidden.ndim - 1,), (0,)), ((), ())))
         return cross_entropy_loss(logits, targets, mask)
 
     def training_step(self, params, batch, rng):

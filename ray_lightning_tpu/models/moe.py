@@ -103,16 +103,27 @@ class MoEMLP(nn.Module):
             "w_down", nn.initializers.lecun_normal(),
             (E, self.hidden_dim, D), jnp.float32)
 
+        # every einsum accumulates in f32 and rounds once at the output
+        # (numcheck RLT801's sanctioned shape): operands stay
+        # self.dtype for MXU rate, but the group-length dispatch/combine
+        # contractions and the D/F-extent expert matmuls never sum in
+        # bf16 — on CPU this is bitwise identical to the plain bf16
+        # einsum (XLA accumulates in f32 internally either way)
         expert_in = jnp.einsum(
-            "nsd,nsec->necd", xg.astype(self.dtype), disp.astype(self.dtype))
+            "nsd,nsec->necd", xg.astype(self.dtype),
+            disp.astype(self.dtype),
+            preferred_element_type=jnp.float32).astype(self.dtype)
         gate_up = jnp.einsum(
-            "necd,edf->necf", expert_in, w_gate_up.astype(self.dtype))
+            "necd,edf->necf", expert_in, w_gate_up.astype(self.dtype),
+            preferred_element_type=jnp.float32).astype(self.dtype)
         gate, up = jnp.split(gate_up, 2, axis=-1)
         h = nn.silu(gate) * up
         expert_out = jnp.einsum(
-            "necf,efd->necd", h, w_down.astype(self.dtype))
+            "necf,efd->necd", h, w_down.astype(self.dtype),
+            preferred_element_type=jnp.float32).astype(self.dtype)
         y = jnp.einsum(
-            "necd,nsec->nsd", expert_out, comb.astype(self.dtype))
+            "necd,nsec->nsd", expert_out, comb.astype(self.dtype),
+            preferred_element_type=jnp.float32).astype(self.dtype)
 
         # Switch-style load-balance loss: E * sum_e f_e * p_e where f is
         # the RAW router-assignment fraction (no capacity mask — an

@@ -104,7 +104,10 @@ class ResNet(nn.Module):
                 strides = 2 if i > 0 and j == 0 else 1
                 x = self.block_cls(self.num_filters * 2 ** i,
                                    strides=strides, dtype=self.dtype)(x)
-        x = x.mean(axis=(1, 2))                      # global average pool
+        # global average pool straight to f32: the head consumes f32
+        # anyway, so rounding the pooled mean back to bf16 first would
+        # be a pure f32->bf16->f32 round trip (numcheck RLT803)
+        x = x.mean(axis=(1, 2), dtype=jnp.float32)
         return nn.Dense(self.num_classes, dtype=jnp.float32,
                         param_dtype=jnp.float32, name="head")(x)
 

@@ -79,7 +79,12 @@ def dot_product_attention(
         jnp.where(any_visible, scores, 0.0), axis=-1
     ).astype(q.dtype)
     probs = jnp.where(any_visible, probs, 0.0).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    # f32 accumulator over the S_kv extent (numcheck RLT801), one
+    # rounding back to the compute dtype — matches the pallas kernel's
+    # f32 VMEM accumulator, so the parity gap stays rounding-only
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", probs, v,
+        preferred_element_type=jnp.float32).astype(q.dtype)
 
 
 def flash_uses_pallas(q_shape, k_shape, use_pallas: bool | None = None,

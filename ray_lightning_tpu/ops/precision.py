@@ -1,0 +1,85 @@
+"""Mixed-precision building blocks: f32-accumulating dots and denses.
+
+The single-rounding contract (numcheck RLT801's sanctioned shape):
+matmul OPERANDS stay narrow (bf16 hits the MXU at full rate), the
+ACCUMULATOR is pinned to f32 with ``preferred_element_type``, and the
+result is rounded at most ONCE — after the full contraction, never
+inside it. The MXU accumulates a bf16 dot in f32 internally either
+way, so on TPU this costs nothing; pinning it makes the contract
+explicit in the jaxpr (auditable by analysis/numcheck.py) and widens
+the backward dgrad/wgrad dots to f32, so gradient reduce-scatters
+ride the wire at f32 instead of bf16 (RLT804). On CPU the rounded
+variant is bitwise identical to the plain narrow dot.
+
+Three shapes of the same contract:
+
+  * `f32_acc_dot_general` — drop-in ``nn.Dense(dot_general=...)``:
+    f32 accumulator, output rounded once back to the operand dtype.
+  * `f32_out_dot_general` — the vocab-projection variant: the output
+    KEEPS the f32 accumulator (logits head straight into f32
+    loss/sampling math, so rounding first would only discard the low
+    bits the softmax normalization runs on).
+  * `F32AccDense` — a biased dense that also adds the bias at f32
+    before the single rounding, so the backward bias gradient (a
+    token-extent reduce_sum) accumulates in f32 too — the part
+    ``nn.Dense(dot_general=f32_acc_dot_general)`` cannot reach,
+    because flax rounds the dot output before its bias add. Param
+    names/shapes/initializers match ``nn.Dense`` exactly (kernel,
+    bias), so PartitionSpecs and checkpoint mappings are unchanged.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["f32_acc_dot_general", "f32_out_dot_general", "F32AccDense"]
+
+
+def f32_acc_dot_general(lhs, rhs, dimension_numbers, precision=None,
+                        preferred_element_type=None):
+    """`nn.Dense` dot_general that accumulates in f32 and rounds ONCE
+    at the output — see the module docstring for the full contract."""
+    del preferred_element_type
+    out = jax.lax.dot_general(lhs, rhs, dimension_numbers,
+                              precision=precision,
+                              preferred_element_type=jnp.float32)
+    return out.astype(jnp.result_type(lhs, rhs))
+
+
+def f32_out_dot_general(lhs, rhs, dimension_numbers, precision=None,
+                        preferred_element_type=None):
+    """The vocab-projection variant of `f32_acc_dot_general`: bf16
+    operands (full MXU rate), f32 accumulator, and the output KEEPS
+    the f32 accumulator for downstream f32 loss/sampling math."""
+    del preferred_element_type
+    return jax.lax.dot_general(lhs, rhs, dimension_numbers,
+                               precision=precision,
+                               preferred_element_type=jnp.float32)
+
+
+class F32AccDense(nn.Module):
+    """``nn.Dense`` with the whole pre-activation kept at f32: narrow
+    operands, f32 dot accumulator, f32 bias add, ONE rounding at the
+    end. At ``dtype=float32`` this is bitwise ``nn.Dense``."""
+
+    features: int
+    dtype: Any = jnp.bfloat16
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (x.shape[-1], self.features), jnp.float32)
+        y = jax.lax.dot_general(
+            x.astype(self.dtype), kernel.astype(self.dtype),
+            (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros_init(),
+                              (self.features,), jnp.float32)
+            y = y + bias
+        return y.astype(self.dtype)

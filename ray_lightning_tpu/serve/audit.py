@@ -267,11 +267,18 @@ def audit_decode_step(model_cfg, engine_cfg: EngineConfig,
                       label: str = "serve decode step",
                       fused: bool = False,
                       fused_prefill: Optional[bool] = None,
-                      traced=None):
+                      traced=None, numerics: bool = True):
     """Full tracecheck walk of the decode step: collective schedule
     (none expected on a single-replica step — each replica is one model
     copy), RLT301/303/307/308 findings, and the liveness HBM peak vs
     the chip budget. Returns a `tracecheck.TraceReport`.
+
+    ``numerics`` additionally runs numcheck's RLT801-805 pass over the
+    same jaxpr (the int8-KV campaign's audit surface: an unscaled int8
+    pool read fires RLT805 right here) and fills the report's
+    ``precision`` ledger — per-dtype params / KV-pool / activation
+    bytes; the decode step has no loss output, so the widest-path entry
+    stays None.
 
     RLT307 (dense-paged-gather) fires when the traced step materializes
     the capacity-wide dense KV view although the fused decode kernel
@@ -306,7 +313,7 @@ def audit_decode_step(model_cfg, engine_cfg: EngineConfig,
     for v in list(jaxpr.invars) + list(jaxpr.constvars):
         env[v] = _VarInfo(_repl(len(getattr(v.aval, "shape", ()))),
                           param=True)
-    peak = auditor.walk(jaxpr, env, 1, False)
+    peak, peak_by = auditor.walk(jaxpr, env, 1, False)
     findings = auditor.findings
     budget = int(topo.hbm_bytes * (1 - reserve_fraction))
     gib = 1024**3
@@ -358,6 +365,38 @@ def audit_decode_step(model_cfg, engine_cfg: EngineConfig,
             symbol=label))
     overlap = classify_overlap(auditor.events, auditor.scopes, topo,
                                scheduled=auditor.saw_prefetch_marker)
+    precision = None
+    if numerics:
+        import jax as _jax
+
+        from ray_lightning_tpu.analysis import numcheck as _numcheck
+
+        findings.extend(_numcheck.numcheck_jaxpr(closed)[0])
+        # the serve ledger's classes: params, the paged KV pool (args
+        # 1-2: the k/v pools — the bytes the int8-KV campaign will
+        # shrink), and whatever else the liveness peak holds
+        params_by: dict = {}
+        for leaf in _jax.tree.leaves(meta["args"][0]):
+            dt = str(leaf.dtype)
+            params_by[dt] = params_by.get(dt, 0) + int(
+                np.prod(leaf.shape or (1,))) * leaf.dtype.itemsize
+        pool_by: dict = {}
+        for pl in meta["args"][1:3]:
+            dt = str(pl.dtype)
+            pool_by[dt] = pool_by.get(dt, 0) + int(
+                np.prod(pl.shape)) * pl.dtype.itemsize
+        act_by: dict = {}
+        for dt, b in peak_by.items():
+            rem = b - params_by.get(dt, 0) - pool_by.get(dt, 0)
+            if rem > 0:
+                act_by[dt] = rem
+        precision = {
+            "params": params_by,
+            "opt_state": {},
+            "activations": act_by,
+            "kv_pool": pool_by,
+            "loss_widest_dtype": None,
+        }
     return TraceReport(
         topology=topo,
         mesh_axes={},
@@ -370,6 +409,7 @@ def audit_decode_step(model_cfg, engine_cfg: EngineConfig,
         hbm_budget_bytes=budget,
         label=label,
         pallas_kernels=auditor.pallas_kernels,
+        precision=precision,
     )
 
 

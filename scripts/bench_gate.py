@@ -98,9 +98,15 @@ STATIC = {"overlap_hidden_fraction"}
 #: nothing may quietly re-materialize the gather. Static class:
 #: ratchets on skip lines too; a line carrying the metric's waiver
 #: error field instead waives (analysis bug != regression).
+#: low_precision_reductions is numcheck's count of narrow-accumulation
+#: findings on the flagship trace (RLT801 bf16 dot/reduce accumulations
+#: + RLT804 bf16 gradient collectives, analysis/numcheck.py): 0 since
+#: the f32-accumulation fixes, zero-anchored here — no future change
+#: may quietly reintroduce a bf16 reduction into the flagship step.
 CEILING = {"dcn_bytes_per_step": "dcn_bytes_per_step",
            "serve_hbm_bytes_per_replica": "serve_hbm_bytes_per_replica",
-           "serve_prefill_gather_bytes": "serve_prefill_gather_bytes"}
+           "serve_prefill_gather_bytes": "serve_prefill_gather_bytes",
+           "low_precision_reductions": "low_precision_reductions"}
 
 #: ceiling metric -> error fields whose presence waives an ABSENT
 #: value (the analysis that computes the static metric died and said
@@ -111,6 +117,7 @@ CEILING_WAIVERS = {
                                     "tracecheck_error"),
     "serve_prefill_gather_bytes": ("serving_error",
                                    "tracecheck_error"),
+    "low_precision_reductions": ("numerics_error",),
 }
 
 #: ceiling metric -> short rationale for the failure message
@@ -125,6 +132,10 @@ CEILING_WHY = {
         "the prefill lane's dense per-group gather is retired by the "
         "fused paged-prefill kernel — its bytes may only shrink, and "
         "nothing may quietly re-materialize the gather"),
+    "low_precision_reductions": (
+        "the flagship step accumulates every long reduction in f32 "
+        "(numcheck RLT801/RLT804) — the count is zero-anchored and no "
+        "change may quietly reintroduce a bf16 accumulation"),
 }
 
 #: metric -> max allowed value on a measured (non-skip) line; absent or
