@@ -556,7 +556,17 @@ def _serve_summary() -> dict:
     line — ISSUE 15) is the prefill lane's surviving per-group dense
     gather on the same plan — 0 once the fused prefill kernel covers
     the shape; bench_gate CEILING-ratchets it the same way (it may
-    only shrink, anchoring the retirement)."""
+    only shrink, anchoring the retirement).
+
+    ``serve_tp`` (EVERY line — ISSUE 18) prices ONE RANK of the
+    flagship TP=2 sharded replica (docs/SERVING.md "sharded
+    replicas"): per-shard params/pool/total HBM plus the decode step's
+    collective schedule over the replica's own tensor mesh — all from
+    `serve/audit.py` tracing, no backend touch.
+    ``serve_decode_ici_bytes_per_tick`` (top-level, EVERY line) is
+    that schedule's total wire bytes per decode tick; bench_gate
+    CEILING-ratchets it (decode collectives ride the latency-critical
+    path, so their per-tick traffic may only shrink)."""
     try:
         import jax.numpy as jnp
 
@@ -569,7 +579,27 @@ def _serve_summary() -> dict:
                             blocks_per_slot=256, prefill_chunk=256)
         plan = serve_memory_summary(cfg, ecfg)
         reference = serve_memory_summary(cfg, ecfg, fused=False)
-        return {"serving": {
+        from ray_lightning_tpu.serve.audit import audit_decode_step
+
+        tp = 2
+        plan_tp = serve_memory_summary(cfg, ecfg, tp=tp)
+        report_tp = audit_decode_step(cfg, ecfg, tp=tp)
+        ici_tick = sum(e.wire_bytes for e in report_tp.collectives)
+        serve_tp = {
+            "tp": tp,
+            "hbm_bytes_per_shard": plan_tp["per_device_bytes"],
+            "params_bytes_per_shard": plan_tp["params_bytes"],
+            "pool_bytes_per_shard": plan_tp["pool_bytes"],
+            "decode_ici_bytes_per_tick": ici_tick,
+            "collectives": [
+                {"kind": e.kind, "axes": list(e.axes),
+                 "payload_bytes": e.payload_bytes, "count": e.count,
+                 "wire_bytes": e.wire_bytes, "source": e.source}
+                for e in report_tp.collectives],
+        }
+        return {"serve_tp": serve_tp,
+                "serve_decode_ici_bytes_per_tick": ici_tick,
+                "serving": {
             "schema": ["decode_tokens_per_s", "prefill_tokens_per_s",
                        "ttft_cold_s", "ttft_warm_s", "ttft_p99_s",
                        "slot_occupancy", "serving_attention_path",

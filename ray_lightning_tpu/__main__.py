@@ -280,7 +280,7 @@ def _run_serve_plan(args) -> int:
     )
     from ray_lightning_tpu.serve.engine import EngineConfig
 
-    for name in ("serve_slots", "serve_block_size"):
+    for name in ("serve_slots", "serve_block_size", "tp"):
         if getattr(args, name) < 1:
             return _plan_invalid(
                 f"--{name.replace('_', '-')} must be >= 1, got "
@@ -299,7 +299,7 @@ def _run_serve_plan(args) -> int:
                               args.seq))
         summary = serve_memory_summary(
             cfg, ecfg, device_kind=args.device_kind,
-            hbm_bytes=args.hbm_bytes)
+            hbm_bytes=args.hbm_bytes, tp=args.tp)
     except ValueError as exc:
         return _plan_invalid(str(exc), args.as_json)
     trace = None
@@ -314,7 +314,7 @@ def _run_serve_plan(args) -> int:
             fused = summary["attention_path"] == "paged-pallas"
             report = audit_decode_step(cfg, ecfg, topology=topo,
                                        label=f"{args.preset} serve",
-                                       fused=fused)
+                                       fused=fused, tp=args.tp)
             trace = {
                 "attention_path": summary["attention_path"],
                 "peak_hbm_bytes": report.peak_hbm_bytes,
@@ -323,6 +323,21 @@ def _run_serve_plan(args) -> int:
                 **({"precision": report.precision}
                    if getattr(args, "precision", False) else {}),
             }
+            if args.tp > 1:
+                # the decode step's collective schedule over the
+                # replica group's own mesh — the per-tick ICI story
+                # `bench --static`'s serve_tp section and the bench
+                # gate's serve_decode_ici_bytes_per_tick ratchet read
+                trace["collectives"] = [
+                    {"kind": e.kind, "axes": list(e.axes),
+                     "payload_bytes": e.payload_bytes,
+                     "count": e.count, "wire_bytes": e.wire_bytes,
+                     "source": e.source,
+                     **({"param": e.param_path} if e.param_path
+                        else {})}
+                    for e in report.collectives]
+                trace["decode_ici_bytes_per_tick"] = sum(
+                    e.wire_bytes for e in report.collectives)
         except Exception as exc:  # noqa: BLE001 — advisory section only
             trace = {"trace_error":
                      f"{type(exc).__name__}: {str(exc)[:300]}"}
@@ -343,6 +358,18 @@ def _run_serve_plan(args) -> int:
                       f"{trace['peak_hbm_bytes'] / gib:.2f} GiB vs "
                       f"budget {trace['hbm_budget_bytes'] / gib:.2f} "
                       f"GiB; findings: {rules if rules else 'none'}")
+                if trace.get("collectives") is not None:
+                    kib = 1024.0
+                    print("  decode collectives (per tick, one "
+                          "replica group):")
+                    for ev in trace["collectives"]:
+                        print(f"    {ev['kind']:<11} "
+                              f"x{ev['count']:<3} "
+                              f"{ev['payload_bytes'] / kib:8.1f} KiB  "
+                              f"wire {ev['wire_bytes'] / kib:8.1f} "
+                              f"KiB  {ev['source']}")
+                    ici_kib = trace["decode_ici_bytes_per_tick"] / kib
+                    print(f"    ICI bytes/tick: {ici_kib:.1f} KiB")
                 _print_precision_ledger(trace.get("precision"))
     return 0 if summary["fits"] else 1
 
@@ -561,6 +588,14 @@ def main(argv=None) -> int:
     plan_p.add_argument("--serve-block-size", type=int, default=16,
                         help="KV pool block size in tokens "
                              "(plan --serve)")
+    plan_p.add_argument("--tp", type=int, default=1,
+                        help="tensor-parallel degree of ONE serving "
+                             "replica (plan --serve): prices one rank "
+                             "of the replica group — per-shard params "
+                             "+ pool HBM and the decode step's "
+                             "collective schedule over the replica's "
+                             "own mesh (docs/SERVING.md 'sharded "
+                             "replicas')")
     plan_p.add_argument("--find-max-batch", action="store_true",
                         help="ignore --batch and report the largest "
                              "per-device batch (and the implied global "

@@ -193,6 +193,16 @@ RULES: Dict[str, Rule] = {r.id: r for r in (
          "bound (read_spans/read_metrics tail_bytes=, load_signal "
          "window=) — the readers keep the clock-alignment header and "
          "the newest entries, which is all a live view needs"),
+    Rule("RLT504", "per-token-channel-chatter", "warning",
+         "a per-decode-tick loop does an unbatched channel send/recv "
+         "PER TOKEN (a queue put / channel send / reader poll inside a "
+         "for-loop over the tick's emissions): every emitted token "
+         "pays a syscall + fsync + wakeup, so the wire chatter scales "
+         "with tokens/tick instead of ticks, and the worker loop "
+         "stalls on I/O the engine tick already amortized. Batch the "
+         "tick's emissions into ONE side-channel item and ack ONE "
+         "highest-seq per poll batch (serve/channel.py, "
+         "docs/SERVING.md 'the request channel')"),
     # RLT6xx — elasticity anti-patterns (docs/ELASTIC.md): code that
     # pins a job to one world size for life.
     Rule("RLT601", "pinned-world-size", "warning",

@@ -1515,6 +1515,101 @@ class _LedgerTailLint:
                 self._lint_call(node, fn.qualname)
 
 
+# ---- RLT504: per-token channel chatter ------------------------------------
+
+#: iteration sources that are one TICK's emitted tokens — the engine
+#: returns them as a batch, so anything looping them is per-token
+_RLT504_EMISSIONS_RE = re.compile(
+    r"(?:^|_)(emissions|emitted|toks|tokens)(?:_|$)", re.IGNORECASE)
+#: channel-shaped receivers: the request channel's writer/reader, the
+#: worker side-channel queue, or anything named like one
+_RLT504_RECEIVER_RE = re.compile(
+    r"(?:^|_)(queue|channel|chan|writer|reader|sock|conn|pipe)"
+    r"(?:_|$|\d)", re.IGNORECASE)
+#: send/recv verbs that cost a syscall (+fsync on the command log) each
+_RLT504_VERBS = {"send", "put", "put_nowait", "recv", "poll",
+                 "send_bytes", "recv_bytes"}
+
+
+class _ChannelChatterLint:
+    """RLT504 per-token-channel-chatter (docs/SERVING.md "the request
+    channel"): a serving worker's per-tick loop over the engine's
+    emitted tokens doing an UNBATCHED channel operation per element.
+    The engine tick already amortized the device work into one call; a
+    per-token queue put / channel send / reader poll reintroduces a
+    syscall (and on the command log an fsync) per TOKEN, so the wire
+    chatter scales with tokens/tick instead of ticks and the worker
+    loop stalls on I/O between emissions. The batched discipline —
+    accumulate the tick's emissions, ONE side-channel item per
+    iteration, ONE highest-seq ack per poll batch
+    (serve/driver.py `_replica_session_main`) — never fires: its
+    sends sit outside the per-token loop."""
+
+    def __init__(self, lint: _FileLint):
+        self.lint = lint
+
+    @staticmethod
+    def _emissions_name(it: ast.AST) -> Optional[str]:
+        """Terminal name in the loop's iterable that reads as a token
+        batch (`last_emissions`, `emitted`, `toks`) — looks through
+        zip()/enumerate()/attribute chains."""
+        for node in ast.walk(it):
+            name = None
+            if isinstance(node, ast.Name):
+                name = node.id
+            elif isinstance(node, ast.Attribute):
+                name = node.attr
+            if name and _RLT504_EMISSIONS_RE.search(name):
+                return name
+        return None
+
+    def _lint_loop(self, loop: ast.For,
+                   symbol: Optional[str]) -> None:
+        src = self._emissions_name(loop.iter)
+        if src is None:
+            return
+        for node in _rlt503_loop_nodes(loop):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _RLT504_VERBS):
+                continue
+            recv = (_dotted(node.func.value) or "").split(".")[-1]
+            if not _RLT504_RECEIVER_RE.search(recv):
+                continue
+            self.lint.add(
+                "RLT504",
+                f"{recv}.{node.func.attr}() runs once per element of "
+                f"{src!r} — an unbatched channel operation per emitted "
+                "TOKEN: each pays a syscall (+fsync on the command "
+                "log) and a receiver wakeup, so wire chatter scales "
+                "with tokens/tick instead of ticks and the decode "
+                "loop stalls on I/O the engine tick already "
+                "amortized. Batch the tick's emissions into ONE "
+                "side-channel item and ack ONE highest-seq per poll "
+                "batch (serve/channel.py, docs/SERVING.md 'the "
+                "request channel')", node, symbol)
+
+    def run(self, tree: ast.Module, funcs: List["_Func"]) -> None:
+        traced_nodes = {id(fn.node) for fn in funcs if fn.traced}
+
+        def walk(stmts, symbol):
+            for node in stmts:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    # a traced loop has no channel to chatter on —
+                    # same scope rule as the other serve-loop lints
+                    if id(node) not in traced_nodes:
+                        walk(node.body, node.name)
+                    continue
+                if isinstance(node, ast.Lambda):
+                    continue
+                if isinstance(node, ast.For):
+                    self._lint_loop(node, symbol)
+                walk(list(ast.iter_child_nodes(node)), symbol)
+
+        walk(tree.body, None)
+
+
 def lint_source(source: str, filename: str = "<string>",
                 extra_axes: Sequence[str] = ()) -> List[Finding]:
     """Lint one file's source text. Never imports the target."""
@@ -1576,6 +1671,7 @@ def lint_source(source: str, filename: str = "<string>",
     _ServeLoopLint(lint).run(tree, coll.funcs)
     _PinnedWorldLint(lint).run(tree)
     _LedgerTailLint(lint).run(tree, coll)
+    _ChannelChatterLint(lint).run(tree, coll.funcs)
     return lint.findings
 
 

@@ -1076,6 +1076,9 @@ class _StepAuditor:
             set_all([self._gather_prim(eqn, infos, avals, mult, src)])
         elif name in ("scatter-add", "scatter_add"):
             set_all([self._scatter_add(eqn, infos, avals, mult, src)])
+        elif name == "scatter":
+            set_all([self._scatter_overwrite(eqn, infos, avals, mult,
+                                             src)])
         elif name in _REPLICATED_SOURCES:
             set_all([_VarInfo(_repl(len(getattr(v.aval, "shape", ()))),
                               param=True) for v in out])
@@ -1370,6 +1373,20 @@ class _StepAuditor:
         else:
             spec = tuple(base)
         return _VarInfo(spec, param=op.param and upd.param, path=op.path)
+
+    def _scatter_overwrite(self, eqn, infos, avals, mult, src) -> _VarInfo:
+        # plain functional scatter (`x.at[idx].set(v)` — the serving
+        # engine's per-slot paged-KV writes lower here once vmapped over
+        # slots): GSPMD keeps the OPERAND's layout and reshards the
+        # (small) updates to match, so the result inherits the operand
+        # spec verbatim. Unlike scatter-add there is no partial sum to
+        # resolve — an overwrite never manufactures a reduction.
+        op, _, upd = infos[0], infos[1], infos[2]
+        if op.spec is None:
+            return _VarInfo(None, param=op.param and upd.param,
+                            path=op.path)
+        return _VarInfo(tuple(op.spec), param=op.param and upd.param,
+                        path=op.path)
 
     def _broadcast(self, eqn, info) -> _VarInfo:
         shape = eqn.params["shape"]

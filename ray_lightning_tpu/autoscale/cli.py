@@ -4,10 +4,10 @@ autoscaler demo + the format.sh smoke gate.
     python -m ray_lightning_tpu autoscale            # scripted demo
     python -m ray_lightning_tpu autoscale --smoke    # the gate
 
-``--smoke`` (docs/AUTOSCALE.md "acceptance") runs three CPU legs on the
-deterministic scripted-load harness (`autoscale/sim.py` — the driver
-tick counter is the clock, so nothing here is wall-clock sensitive)
-and exits 1 unless ALL hold:
+``--smoke`` (docs/AUTOSCALE.md "acceptance") runs four CPU legs —
+three on the deterministic scripted-load harness (`autoscale/sim.py` —
+the driver tick counter is the clock, so nothing there is wall-clock
+sensitive) plus a process-backend ramp — and exits 1 unless ALL hold:
 
   * **ramp leg** — under a scripted load ramp the controller scales
     1 -> 2 on sustained pressure and back to 1 on idle, exactly once
@@ -25,7 +25,15 @@ and exits 1 unless ALL hold:
   * **deferral leg** — with every replica draining, `submit()` defers
     with a structured reason (driver ``submit_deferrals`` counter)
     instead of round-robining onto a stopping replica, and the
-    deferred stream completes bitwise once a replica is live again.
+    deferred stream completes bitwise once a replica is live again;
+  * **process-ramp leg** — the same 1 -> 2 -> 1 ramp against REAL
+    worker processes, every command flowing over the request channel
+    (serve/channel.py): `add_replica` spawns a process replica
+    mid-session, `remove_replica(graceful=True)` drains it over the
+    channel, an injected mid-stream SIGKILL is classified and absorbed
+    by the channel-epoch respawn replay, and every stream still lands
+    bitwise — the leg that retired the old "dynamic sessions are
+    inline-backend only" limit (docs/SERVING.md "request channel").
 """
 from __future__ import annotations
 
@@ -198,6 +206,11 @@ def run_smoke(args) -> int:
             failures, cfg, params, ecfg, reqs, refs,
             os.path.join(tmp, "run"))
 
+    # ---- leg 4: process-backend ramp over the request channel ---------
+    with tempfile.TemporaryDirectory(prefix="rlt-autoscale-") as tmp:
+        verdict["legs"]["process_ramp"] = _smoke_process_ramp(
+            failures, ecfg, os.path.join(tmp, "run"))
+
     verdict["ok"] = not failures
     if failures:
         verdict["failures"] = failures
@@ -312,6 +325,85 @@ def _smoke_deferral(failures: list, cfg, params, ecfg, reqs, refs,
     if bad:
         failures.append(
             f"deferred stream diverged after re-routing: {bad}")
+    return leg
+
+
+def _smoke_process_ramp(failures: list, ecfg, run_dir: str,
+                        n_requests: int = 6, max_new: int = 8) -> dict:
+    """The process-backend ramp: 1 -> 2 -> 1 REAL worker processes,
+    every command flowing over the request channel (serve/channel.py),
+    with an injected mid-stream SIGKILL absorbed by the classified
+    respawn + channel-epoch replay. This is the leg that retired
+    docs/AUTOSCALE.md's old "dynamic sessions are inline-backend only"
+    limit: the same `add_replica`/`remove_replica(graceful=True)` seams
+    the controller actuates, against spawned processes instead of
+    inline engines. Scripted actuation (not policy polling) keeps the
+    leg deterministic — the policy's signal loop is leg 1's job; this
+    leg pins the ACTUATION seams the controller calls."""
+    import numpy as np
+
+    from ray_lightning_tpu.serve.cli import _references, _tiny_setup
+    from ray_lightning_tpu.serve.driver import (
+        ReplicaGroupConfig, ServeDriver, save_params_npz,
+    )
+
+    cfg, model, params, prompts, reqs = _tiny_setup(n_requests, max_new)
+    refs = _references(model, params, prompts, reqs)
+    ppath = os.path.join(run_dir, "params.npz")
+    os.makedirs(run_dir, exist_ok=True)
+    save_params_npz(params, ppath)
+    drv = ServeDriver(cfg, ppath, ReplicaGroupConfig(
+        n_replicas=1, backend="process", engine=ecfg,
+        run_dir=run_dir, platform="cpu", cpu_devices_per_rank=1,
+        max_restarts=2, metrics_flush_every_n_ticks=2))
+    # the SIGKILL lands mid-stream on replica 0 after a few emitted
+    # tokens: the session thread classifies the death (retryable /
+    # worker-signal), respawns the replica on a fresh channel epoch,
+    # and the replayed commands regenerate every stream bitwise
+    drv.start(fault={"replica": 0, "kill_after_tokens": 6})
+    half = max(1, len(reqs) // 2)
+    for r in reqs[:half]:
+        drv.submit(r)
+    added = drv.add_replica()          # scale 1 -> 2, over the channel
+    for r in reqs[half:]:
+        drv.submit(r)
+    import time as _time
+    while drv.busy():
+        drv.tick()
+        _time.sleep(0.01)
+    victim = drv.remove_replica(graceful=True)   # scale 2 -> 1: drain op
+    result = drv.stop()
+    bad = [rid for rid, ref in refs.items()
+           if not np.array_equal(
+               np.asarray(result.outputs.get(rid, [])), ref)]
+    leg = {
+        "added": added, "removed": victim,
+        "replicas_spawned": result.stats["replicas_spawned"],
+        "final_replicas": result.stats["final_replicas"],
+        "restarts": {str(k): v for k, v in result.restarts.items()},
+        "bitwise_mismatches": bad,
+        "completed": len(result.meta),
+    }
+    if bad:
+        failures.append(
+            f"process-backend streams diverge from generate() across "
+            f"the ramp + SIGKILL respawn: {bad}")
+    if result.stats["replicas_spawned"] != 2:
+        failures.append(
+            f"process ramp spawned {result.stats['replicas_spawned']} "
+            "replicas, want 2 (1 -> 2 via the channel)")
+    if result.stats["final_replicas"] != 1:
+        failures.append(
+            f"process ramp must end back at 1 replica, ended at "
+            f"{result.stats['final_replicas']}")
+    if result.restarts.get(0, 0) < 1:
+        failures.append(
+            "the injected mid-stream SIGKILL was not absorbed by a "
+            f"classified respawn (restarts: {result.restarts})")
+    if len(result.meta) != len(reqs):
+        failures.append(
+            f"process ramp dropped streams: {len(result.meta)}/"
+            f"{len(reqs)} completed")
     return leg
 
 

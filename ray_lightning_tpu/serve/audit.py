@@ -262,16 +262,86 @@ def _prefill_paged_gathers(jaxpr, pool_shape, capacity: int,
     return hits
 
 
+def _tp_invar_seeds(model_cfg, meta, tp: int):
+    """`_VarInfo` seeds for the step's invars under a ``tp``-way tensor
+    mesh — the SAME layout `DecodeEngine` places, so the audited
+    collectives are the served ones: params via
+    `engine.serving_param_specs` (wqkv/gate_up column-split, wo/w_down
+    row-split, embeddings vocab-split), the two pool leaves KV-head
+    sharded (`kv_cache.pool_partition_spec`), every host-fed input and
+    the carried logits replicated (the scheduler is tp-oblivious)."""
+    import dataclasses as _dc
+
+    import jax
+
+    from ray_lightning_tpu.analysis.tracecheck import (
+        _repl, _spec_of_partition_spec, _VarInfo,
+    )
+    from ray_lightning_tpu.models.llama import Llama
+    from ray_lightning_tpu.parallel.mesh import MeshSpec
+    from ray_lightning_tpu.serve.engine import serving_param_specs
+    from ray_lightning_tpu.serve.kv_cache import (
+        pool_partition_spec, validate_pool_tp,
+    )
+
+    validate_pool_tp(model_cfg, tp)
+    live = {"tensor"}
+
+    def canon(spec_t):
+        return tuple(frozenset(ax for ax in s if ax in live)
+                     for s in spec_t)
+
+    axis_names = tuple(f.name for f in _dc.fields(MeshSpec))
+    a_params = meta["args"][0]
+    model = Llama(model_cfg)
+    seeds = []
+    # shape->spec matcher for vars the walk re-derives structurally —
+    # scan-SLICED per-layer weights chiefly (audit_step's discipline:
+    # a stacked [L, ...] leaf also registers its per-trip suffix)
+    param_shapes = {}
+    for (path, spec), leaf in zip(
+            serving_param_specs(model, a_params, axis_names),
+            jax.tree.leaves(a_params)):
+        shape = tuple(getattr(leaf, "shape", ()))
+        cspec = canon(_spec_of_partition_spec(spec, len(shape)))
+        seeds.append(_VarInfo(cspec, param=True, path=f"params/{path}"))
+        param_shapes.setdefault(shape, (cspec, f"params/{path}"))
+        if len(shape) >= 2:
+            param_shapes.setdefault(shape[1:],
+                                    (cspec[1:], f"params/{path}"))
+    pool_spec = canon(_spec_of_partition_spec(pool_partition_spec(tp), 5))
+    for i, arg in enumerate(meta["args"][1:], start=1):
+        ndim = len(getattr(arg, "shape", ()))
+        if i in (1, 2):
+            seeds.append(_VarInfo(
+                pool_spec, param=True,
+                path="pool_k" if i == 1 else "pool_v"))
+        else:
+            seeds.append(_VarInfo(_repl(ndim), param=True))
+    return seeds, param_shapes
+
+
 def audit_decode_step(model_cfg, engine_cfg: EngineConfig,
                       topology="v5p-8", reserve_fraction: float = 0.10,
                       label: str = "serve decode step",
                       fused: bool = False,
                       fused_prefill: Optional[bool] = None,
-                      traced=None, numerics: bool = True):
+                      traced=None, numerics: bool = True,
+                      tp: int = 1):
     """Full tracecheck walk of the decode step: collective schedule
-    (none expected on a single-replica step — each replica is one model
-    copy), RLT301/303/307/308 findings, and the liveness HBM peak vs
-    the chip budget. Returns a `tracecheck.TraceReport`.
+    (none expected on a single-replica tp=1 step — each replica is one
+    model copy), RLT301/303/307/308 findings, and the liveness HBM peak
+    vs the chip budget. Returns a `tracecheck.TraceReport`.
+
+    ``tp > 1`` audits ONE RANK of a tensor-parallel replica: the
+    invars are seeded with the engine's served layout
+    (`_tp_invar_seeds`) and the walk prices the decode step's implicit
+    collectives — the per-tick attention/MLP psums over the ``tensor``
+    axis — exactly the way training steps are priced (wire bytes on
+    ICI; ``sum(ev.wire_bytes for ev in report.collectives)`` is the
+    decode ICI bytes/tick the bench gate ratchets). The traced program
+    is identical (SPMD comes from shardings at jit time), so ``traced``
+    reuse stays valid across ``tp`` values.
 
     ``numerics`` additionally runs numcheck's RLT801-805 pass over the
     same jaxpr (the int8-KV campaign's audit surface: an unscaled int8
@@ -307,13 +377,50 @@ def audit_decode_step(model_cfg, engine_cfg: EngineConfig,
                     else trace_decode_step(model_cfg, engine_cfg,
                                            fused=fused,
                                            fused_prefill=fused_prefill))
-    auditor = _StepAuditor({}, topo, {})
+    seeds, param_shapes = (_tp_invar_seeds(model_cfg, meta, tp)
+                           if tp > 1 else (None, {}))
+    auditor = _StepAuditor({"tensor": tp} if tp > 1 else {}, topo,
+                           param_shapes)
     jaxpr = closed.jaxpr
     env = {}
-    for v in list(jaxpr.invars) + list(jaxpr.constvars):
+    if seeds is not None:
+        n = min(len(jaxpr.invars), len(seeds))
+        for v, s in zip(jaxpr.invars[:n], seeds[:n]):
+            env[v] = s
+        for v in jaxpr.invars[n:]:
+            env[v] = _VarInfo(
+                _repl(len(getattr(v.aval, "shape", ()))), param=True)
+    else:
+        for v in jaxpr.invars:
+            env[v] = _VarInfo(
+                _repl(len(getattr(v.aval, "shape", ()))), param=True)
+    for v in jaxpr.constvars:
         env[v] = _VarInfo(_repl(len(getattr(v.aval, "shape", ()))),
                           param=True)
     peak, peak_by = auditor.walk(jaxpr, env, 1, False)
+    if tp > 1:
+        # the engine's jit pins every non-pool output REPLICATED at the
+        # boundary (DecodeEngine out_shardings): the column-split
+        # lm_head leaves `last_logits` vocab-sharded, so GSPMD
+        # all-gathers it over `tensor` at the step's edge — the
+        # dominant decode collective by bytes, and invisible inside the
+        # traced function (the constraint lives in jit metadata, not
+        # the jaxpr). Priced here from the walked output specs: the
+        # pools (outvars 0-1) keep their sharding, everything else
+        # gathers whatever tensor axes survive to the boundary.
+        for i, v in enumerate(jaxpr.outvars):
+            if i < 2:
+                continue
+            spec = auditor._info(v, env).spec
+            if not spec:
+                continue
+            lost = {ax for s in spec for ax in s}
+            if lost:
+                auditor.record(
+                    "all_gather", auditor._aval_bytes(v.aval, None),
+                    sorted(lost), 1, implicit=True,
+                    source="jit boundary (replicated out_shardings)",
+                    dtype=str(getattr(v.aval, "dtype", "")) or None)
     findings = auditor.findings
     budget = int(topo.hbm_bytes * (1 - reserve_fraction))
     gib = 1024**3
@@ -374,17 +481,22 @@ def audit_decode_step(model_cfg, engine_cfg: EngineConfig,
         findings.extend(_numcheck.numcheck_jaxpr(closed)[0])
         # the serve ledger's classes: params, the paged KV pool (args
         # 1-2: the k/v pools — the bytes the int8-KV campaign will
-        # shrink), and whatever else the liveness peak holds
+        # shrink), and whatever else the liveness peak holds. tp > 1:
+        # per-SHARD bytes via the seeded specs (same division the
+        # liveness walk applied)
+        p_leaves = _jax.tree.leaves(meta["args"][0])
         params_by: dict = {}
-        for leaf in _jax.tree.leaves(meta["args"][0]):
+        for i, leaf in enumerate(p_leaves):
             dt = str(leaf.dtype)
-            params_by[dt] = params_by.get(dt, 0) + int(
-                np.prod(leaf.shape or (1,))) * leaf.dtype.itemsize
+            b = (auditor._aval_bytes(leaf, seeds[i].spec)
+                 if seeds is not None else
+                 int(np.prod(leaf.shape or (1,))) * leaf.dtype.itemsize)
+            params_by[dt] = params_by.get(dt, 0) + b
         pool_by: dict = {}
         for pl in meta["args"][1:3]:
             dt = str(pl.dtype)
             pool_by[dt] = pool_by.get(dt, 0) + int(
-                np.prod(pl.shape)) * pl.dtype.itemsize
+                np.prod(pl.shape)) * pl.dtype.itemsize // tp
         act_by: dict = {}
         for dt, b in peak_by.items():
             rem = b - params_by.get(dt, 0) - pool_by.get(dt, 0)
@@ -397,13 +509,21 @@ def audit_decode_step(model_cfg, engine_cfg: EngineConfig,
             "kv_pool": pool_by,
             "loss_widest_dtype": None,
         }
+    params_dev = meta["params_bytes"]
+    if seeds is not None:
+        import jax as _jax2
+
+        params_dev = sum(
+            auditor._aval_bytes(leaf, s.spec)
+            for leaf, s in zip(_jax2.tree.leaves(meta["args"][0]),
+                               seeds))
     return TraceReport(
         topology=topo,
-        mesh_axes={},
+        mesh_axes={"tensor": tp} if tp > 1 else {},
         collectives=auditor.events,
         overlap=overlap,
         findings=findings,
-        params_bytes_per_device=meta["params_bytes"],
+        params_bytes_per_device=params_dev,
         opt_bytes_per_device=0,
         peak_hbm_bytes=peak,
         hbm_budget_bytes=budget,
@@ -417,7 +537,8 @@ def serve_memory_summary(model_cfg, engine_cfg: EngineConfig,
                          device_kind: str = "TPU v5p",
                          hbm_bytes: Optional[int] = None,
                          fused: Optional[bool] = None,
-                         fused_prefill: Optional[bool] = None) -> dict:
+                         fused_prefill: Optional[bool] = None,
+                         tp: int = 1) -> dict:
     """The serve-aware plan leg: itemized replica HBM (no optimizer —
     serving holds weights, the paged pool, the attention paths'
     surviving gathered view, and the carried logits) with a fits
@@ -428,7 +549,16 @@ def serve_memory_summary(model_cfg, engine_cfg: EngineConfig,
     support (the planner prices the paths the TPU deployment will run
     — `_shape_fused_available` / `_shape_fused_prefill_available`);
     pass False/True to price a specific path (the before/after table
-    in docs/SERVING.md is exactly these pairs)."""
+    in docs/SERVING.md is exactly these pairs).
+
+    ``tp > 1`` prices ONE RANK of a tensor-parallel replica (the
+    ``plan --serve --tp N`` leg): params divide by ``tp`` exactly where
+    the engine's layout shards them (`engine.serving_param_specs` —
+    replicated leaves like norm gains stay whole), the pool and every
+    KV view carry the head axis and divide, and the carried logits
+    stay replicated. The fits verdict is per-chip."""
+    import dataclasses as _dc
+
     import jax
     import numpy as np
 
@@ -448,14 +578,29 @@ def serve_memory_summary(model_cfg, engine_cfg: EngineConfig,
     a_params = jax.eval_shape(
         lambda k: model.init(k, np.zeros((1, 2), np.int32))["params"],
         jax.eval_shape(lambda: jax.random.key(0)))
-    params_bytes = sum(
-        int(np.prod(leaf.shape or (1,))) * leaf.dtype.itemsize
-        for leaf in jax.tree.leaves(a_params))
+    if tp > 1:
+        from ray_lightning_tpu.parallel.mesh import MeshSpec
+        from ray_lightning_tpu.serve.engine import serving_param_specs
+
+        axis_names = tuple(f.name for f in _dc.fields(MeshSpec))
+        params_bytes = 0
+        for (_, pspec), leaf in zip(
+                serving_param_specs(model, a_params, axis_names),
+                jax.tree.leaves(a_params)):
+            b = int(np.prod(leaf.shape or (1,))) * leaf.dtype.itemsize
+            if any("tensor" in ((e,) if isinstance(e, str) else tuple(e))
+                   for e in tuple(pspec) if e is not None):
+                b //= tp
+            params_bytes += b
+    else:
+        params_bytes = sum(
+            int(np.prod(leaf.shape or (1,))) * leaf.dtype.itemsize
+            for leaf in jax.tree.leaves(a_params))
     spec = engine_cfg.pool_spec
     kv = serve_kv_plan_bytes(model_cfg, spec, engine_cfg.capacity,
                              fused=fused,
                              prefill_batch=engine_cfg.prefill_batch,
-                             fused_prefill=fused_prefill)
+                             fused_prefill=fused_prefill, tp=tp)
     budget = hbm_bytes if hbm_bytes is not None else \
         hbm_bytes_for_kind(device_kind)
     usable = int(budget * 0.90)
@@ -472,14 +617,15 @@ def serve_memory_summary(model_cfg, engine_cfg: EngineConfig,
     # on top (costmodel.paged_prefill_traffic_bytes)
     group_span = int(gathered_view_bytes(
         model_cfg, spec, min(engine_cfg.prefill_batch,
-                             engine_cfg.capacity)))
+                             engine_cfg.capacity))) // tp
     itemsize = np.dtype(model_cfg.dtype).itemsize
     chunk_bytes = (2 * model_cfg.n_layers * engine_cfg.prefill_batch
                    * engine_cfg.prefill_chunk * model_cfg.n_kv_heads
-                   * model_cfg.head_dim * itemsize)
+                   * model_cfg.head_dim * itemsize) // tp
     return {
         "params_bytes": int(params_bytes),
         **kv,
+        "tp": tp,
         "attention_path": ("paged-pallas" if fused
                            else "reference-gather"),
         "prefill_attention_path": ("paged-pallas" if fused_prefill
@@ -487,7 +633,7 @@ def serve_memory_summary(model_cfg, engine_cfg: EngineConfig,
         "decode_kv_traffic_bytes_per_tick": paged_decode_traffic_bytes(
             kv["pool_bytes"], serve_kv_plan_bytes(
                 model_cfg, spec, engine_cfg.capacity,
-                fused=False)["gathered_view_bytes"], fused),
+                fused=False, tp=tp)["gathered_view_bytes"], fused),
         "prefill_kv_traffic_bytes_per_chunk":
             paged_prefill_traffic_bytes(group_span, chunk_bytes,
                                         fused_prefill),
@@ -527,11 +673,15 @@ def format_serve_summary(s: dict) -> str:
             "decode + prefill kernels retire it)")
     traffic_tail = ")" if fused else " + dense-view write+read)"
     pf_traffic = s.get("prefill_kv_traffic_bytes_per_chunk")
+    tp = s.get("tp", 1)
+    tp_tag = (f", tp={tp} (per-shard bytes, one rank of the replica "
+              "group)" if tp > 1 else "")
     lines = [
         f"serve plan: {s['capacity']} slots x {s['max_slot_len']} "
         f"tokens, pool {s['n_blocks']} x {s['block_size']}-token "
         f"blocks, attention path: {s.get('attention_path', '?')}, "
-        f"prefill path: {s.get('prefill_attention_path', '?')}",
+        f"prefill path: {s.get('prefill_attention_path', '?')}"
+        + tp_tag,
         f"  params           {s['params_bytes'] / gib:7.2f} GiB",
         f"  kv pool          {s['pool_bytes'] / gib:7.2f} GiB",
         view_line,

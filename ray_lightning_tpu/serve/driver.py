@@ -122,6 +122,20 @@ class ReplicaGroupConfig:
     #: extra env for process replicas (e.g. {"JAX_PLATFORMS": "cpu"})
     env: Optional[Dict[str, str]] = None
     start_timeout: float = 180.0
+    #: tensor-parallel degree of each replica (docs/SERVING.md "sharded
+    #: replicas"): tp > 1 makes every PROCESS replica a
+    #: `runtime.WorkerGroup` of tp ranks over its own tensor mesh —
+    #: the engine's one step lowers as an SPMD program, the pool
+    #: shards over KV heads, every rank runs the scheduler in lockstep
+    #: off the request channel, and rank 0 owns the replica's result
+    #: stream + telemetry. Dynamic sessions only (start/submit/stop).
+    tp: int = 1
+    #: jax platform for session replica ranks (None = inherit the
+    #: worker env; CI sets "cpu" for the gloo fabric)
+    platform: Optional[str] = None
+    #: CPU devices per rank — with ``platform="cpu"`` this is the
+    #: dev-box/CI stand-in for per-host TPU chips (runtime.launch)
+    cpu_devices_per_rank: Optional[int] = None
     #: live metrics + flight recorder (telemetry/metrics.py) — armed
     #: only when ``run_dir`` is set; False turns both off even then
     #: (the zero-overhead pin covers the off state)
@@ -140,6 +154,12 @@ class ReplicaGroupConfig:
             raise ValueError(f"backend={self.backend!r}")
         if self.n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
+        if self.tp < 1:
+            raise ValueError("tp must be >= 1")
+        if self.tp > 1 and self.backend != "process":
+            raise ValueError(
+                "tp > 1 needs backend='process': a sharded replica is "
+                "a WorkerGroup of tp rank processes over its own mesh")
 
 
 @dataclasses.dataclass
@@ -407,6 +427,249 @@ def _replica_worker_main(model_cfg_kw: dict, params_path: str,
             "occupancy": sched.slot_occupancy}
 
 
+# ---- dynamic-session replica worker (the request-channel consumer) --------
+
+def _replica_session_main(model_cfg_kw: dict, params_path: str,
+                          engine_kw: dict, reserve: str, replica: int,
+                          run_dir: Optional[str], session_dir: str,
+                          compile_cache_dir: Optional[str],
+                          fault: Optional[dict],
+                          fault_dir: Optional[str],
+                          metrics_cfg: Optional[dict],
+                          channel_epoch: int, tp: int,
+                          rank: int = 0) -> dict:
+    """One rank of a DYNAMIC-SESSION replica group (serve/channel.py).
+
+    Unlike `_replica_worker_main` (fixed batch shipped at spawn), work
+    arrives over the per-replica command log and results stream back
+    over the existing side channel — the bidirectional wire that lets
+    `ServeDriver` sessions scale a process deployment.
+
+    Every rank (``tp > 1``: the replica spans a WorkerGroup over its
+    own tensor mesh) holds the FULL host-side scheduler in lockstep;
+    rank 0 is the replica **leader**: it alone reads commands at its
+    own pace, journals each state-changing iteration to the cursor log,
+    emits results/acks, and owns the replica's telemetry streams
+    (leader-aggregated: one metrics/flight/span stream per replica, not
+    per rank). Followers replay the leader's journal — scheduler
+    determinism makes their state bit-identical — so the SPMD step
+    always sees every rank enter the same tick with the same inputs.
+
+    Results are BATCHED one side-channel item per tick (tokens,
+    preemptions, completions, the command ack, evictions together) —
+    the channel's documented discipline, lint-enforced as RLT504."""
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.models.llama import Llama, LlamaConfig
+    from ray_lightning_tpu.runtime import session
+    from ray_lightning_tpu.serve.channel import (
+        ChannelReader, CursorReader, CursorWriter, request_from_wire,
+        request_to_wire,
+    )
+
+    if compile_cache_dir:
+        from ray_lightning_tpu.pipeline.compile_cache import (
+            enable_persistent_cache,
+        )
+
+        enable_persistent_cache(compile_cache_dir)
+    dtype = model_cfg_kw.pop("dtype", "float32")
+    cfg = LlamaConfig(**model_cfg_kw, dtype=jnp.dtype(dtype))
+    model = Llama(cfg)
+    params = load_params_npz(params_path)
+    mesh = None
+    if tp > 1:
+        from ray_lightning_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(tensor=tp)
+    t0 = time.perf_counter()
+    engine = DecodeEngine(model, params, EngineConfig(**engine_kw),
+                          mesh=mesh)
+    engine.warmup()
+    warm_s = time.perf_counter() - t0
+    leader = rank == 0
+    mc = metrics_cfg or {}
+    tdir = run_dir if leader else None
+    recorder = _make_recorder(tdir, replica)
+    metrics = _make_metrics(tdir, replica, enabled=mc.get("enabled", True),
+                            flush_every=mc.get("flush_every", 32))
+    flight = _make_flight(tdir, replica, enabled=mc.get("enabled", True),
+                          maxlen=mc.get("flight_ring", 256),
+                          persist_every=mc.get("flight_persist_every", 16))
+    engine.metrics = metrics
+    sched = Scheduler(engine, reserve=reserve, metrics=metrics,
+                      flight=flight)
+    reader = ChannelReader(session_dir, replica, channel_epoch)
+    cursor_w = (CursorWriter(session_dir, replica, channel_epoch)
+                if leader and tp > 1 else None)
+    cursor_r = (CursorReader(session_dir, replica, channel_epoch)
+                if not leader else None)
+    if leader:
+        session.put_queue(("live", replica,
+                           {"warmup_s": round(warm_s, 3)}))
+    kill_after = int((fault or {}).get("kill_after_tokens", 0))
+    marker = (os.path.join(fault_dir, f"replica{replica}.killed")
+              if fault_dir else None)
+    emitted_total = 0
+    state = {"draining": False, "paused": False, "stop": None}
+
+    def apply(cmd) -> List:
+        """Apply one command to the local scheduler; returns evictions
+        (same on every rank — only the leader WIRES them back)."""
+        op = cmd["op"]
+        ev: List = []
+        if op == "submit":
+            sched.enqueue(request_from_wire(cmd["req"]),
+                          int(cmd.get("preempts", 0)))
+        elif op == "drain":
+            state["draining"] = True
+            sched.begin_drain()
+            ev = sched.evict_queued()
+        elif op == "stop":
+            mode = cmd.get("mode", "finish")
+            state["stop"] = mode
+            if mode == "hard":
+                sched.begin_drain()
+                ev = sched.evict_queued() + sched.evict_slotted()
+        elif op == "pause":
+            state["paused"] = True
+        elif op == "resume":
+            state["paused"] = False
+        return ev
+
+    def run_tick():
+        """One scheduler tick -> the batched result item's fields."""
+        completions = sched.tick()
+        toks = [[rid, int(tok)] for rid, tok in sched.last_emissions]
+        preempts = list(sched.last_preemptions)
+        for detail in sched.last_preemption_details:
+            _record_preemption(recorder, detail, replica)
+        dones = []
+        for comp in completions:
+            _record_completion(recorder, comp, replica)
+            dones.append([comp.rid, {
+                "finish_reason": comp.finish_reason,
+                "queue_wait_s": comp.queue_wait_s,
+                "ttft_s": comp.ttft_s, "tpot_s": comp.tpot_s,
+                "decode_s": comp.decode_s, "preempted": comp.preempted,
+                "n_tokens": len(comp.tokens),
+            }])
+            if len(sched.completions) % FLUSH_EVERY_N_COMPLETIONS == 0:
+                recorder.flush()
+        # mid-drain growth-stall preemptions land back in the closed
+        # queue — evict them for the survivors, like the inline tick
+        ev = sched.evict_queued() if state["draining"] else []
+        return toks, preempts, dones, ev
+
+    if leader:
+        while True:
+            cmds = reader.poll()
+            evicted: List = []
+            starts: List = []
+            for cmd in cmds:
+                if cmd["op"] == "submit":
+                    # announce every accepted submit: the driver resets
+                    # the stream's output prefix on this — a no-op for
+                    # fresh work, THE stale-prefix drop for an epoch
+                    # replay after respawn
+                    starts.append(cmd["req"]["rid"])
+                evicted.extend(apply(cmd))
+            if state["stop"] in ("hard", "abort"):
+                if cursor_w is not None and cmds:
+                    cursor_w.advance(reader.last_seq, False)
+                if evicted:
+                    session.put_queue(("batch", replica, {
+                        "ack": reader.last_seq, "evicted":
+                        [[request_to_wire(q), p] for q, p in evicted]}))
+                elif cmds:
+                    session.put_queue(("batch", replica,
+                                       {"ack": reader.last_seq}))
+                break
+            do_tick = not state["paused"] and sched.busy()
+            if cursor_w is not None and (cmds or do_tick):
+                # journal BEFORE the tick: the step's collectives block
+                # until the followers join, and they join by reading
+                # this record
+                cursor_w.advance(reader.last_seq, do_tick)
+            toks, preempts, dones, ev2 = (run_tick() if do_tick
+                                          else ([], [], [], []))
+            evicted.extend(ev2)
+            emitted_total += len(toks)
+            if cmds or toks or preempts or dones or evicted:
+                # ONE side-channel item per iteration — tokens, acks,
+                # completions, evictions batched (RLT504)
+                payload: Dict[str, Any] = {}
+                if starts:
+                    payload["starts"] = starts
+                if toks:
+                    payload["toks"] = toks
+                if preempts:
+                    payload["preempts"] = preempts
+                if dones:
+                    payload["dones"] = dones
+                if evicted:
+                    payload["evicted"] = [[request_to_wire(q), p]
+                                          for q, p in evicted]
+                if cmds:
+                    payload["ack"] = reader.last_seq
+                session.put_queue(("batch", replica, payload))
+            if (kill_after and emitted_total >= kill_after and marker
+                    and not os.path.exists(marker)):
+                # fire-once mid-stream SIGKILL (the ramp leg's injected
+                # death): marker outlives the process, the respawned
+                # group serves the epoch replay to completion
+                with open(marker, "w") as f:
+                    f.write(str(emitted_total))
+                _record_drain(recorder, sched, replica)
+                recorder.flush()
+                metrics.flush()
+                flight.persist()
+                os.kill(os.getpid(), signal.SIGKILL)
+            if ((state["draining"] or state["stop"] == "finish")
+                    and not sched.busy()):
+                break
+            if not do_tick and not cmds:
+                time.sleep(0.004)
+        if cursor_w is not None:
+            cursor_w.end()
+            cursor_w.close()
+    else:
+        # follower: replay the leader's iteration journal verbatim —
+        # no policy, no emissions, just lockstep state + the SPMD step
+        while True:
+            rec = cursor_r.next()
+            if rec is None:
+                time.sleep(0.004)
+                continue
+            if rec.get("end"):
+                break
+            target = int(rec["seq"])
+            cmds = reader.take_upto(target)
+            while reader.last_seq < target:
+                # the command file is written before the cursor record,
+                # but a shared-FS reader can still lag — wait it out
+                time.sleep(0.002)
+                cmds.extend(reader.take_upto(target))
+            for cmd in cmds:
+                apply(cmd)
+            if rec.get("tick"):
+                run_tick()
+    _record_drain(recorder, sched, replica)
+    recorder.flush()
+    recorder.close()
+    if metrics.enabled:
+        # stamp the stream retired so the load signal stops pooling
+        # this replica's stale window into LIVE pressure
+        metrics.gauge("retired", 1)
+        metrics.tick_end()
+    metrics.close()
+    flight.close()
+    return {"replica": replica, "completed": len(sched.completions),
+            "steps": engine.steps, "warmup_s": warm_s,
+            "compile_count": engine.compile_count,
+            "occupancy": sched.slot_occupancy}
+
+
 # ---- the driver ------------------------------------------------------------
 
 class _Replica:
@@ -426,6 +689,40 @@ class _Replica:
         self.state = "live"
         self.spawned_at = time.perf_counter()
         self.warm_s = warm_s
+
+
+class _ProcessReplica:
+    """One PROCESS replica in a dynamic serving session: a spawn/
+    respawn thread around a `runtime.WorkerGroup` of ``cfg.tp`` ranks,
+    a `serve.channel.ChannelWriter` commands flow in over, and the
+    driver-side assignment ledger the respawn replay is computed from.
+    Same three-state lifecycle as `_Replica`."""
+
+    __slots__ = ("id", "state", "spawned_at", "warm_s", "writer",
+                 "assigned", "live_evt", "thread", "attempts",
+                 "restarts", "error", "result", "acked", "warmups")
+
+    def __init__(self, rid: int, writer):
+        import threading
+
+        self.id = rid
+        self.writer = writer
+        self.state = "live"
+        self.spawned_at = time.perf_counter()
+        self.warm_s = None
+        #: requests this replica currently owns, submission order —
+        #: minus completions and evictions; the respawn replay set
+        self.assigned: List[Request] = []
+        self.live_evt = threading.Event()
+        self.thread = None
+        self.attempts = 0
+        self.restarts = 0
+        self.error: Optional[BaseException] = None
+        self.result: Optional[dict] = None
+        #: highest command seq the worker acked (observability + the
+        #: channel tests' replay-safety probe)
+        self.acked = 0
+        self.warmups: List[float] = []
 
 
 class ServeDriver:
@@ -705,6 +1002,11 @@ class ServeDriver:
         """Serve ``requests`` to completion. ``fault`` (process backend
         only): ``{"replica": r, "kill_after_tokens": n}`` SIGKILLs
         replica ``r`` once, mid-stream — the recovery drill."""
+        if self.cfg.tp > 1:
+            raise ValueError(
+                "tp > 1 replicas are dynamic-session only (start()/"
+                "submit()/stop()): the fixed-batch run() ships its "
+                "request list at spawn and stays tp=1")
         # COPY before stamping: mutating the caller's Request objects
         # would make a reused request list carry the previous run's
         # arrival stamps, silently inflating every queue_wait/TTFT of
@@ -726,11 +1028,13 @@ class ServeDriver:
     # (docs/AUTOSCALE.md). `run()` above serves a FIXED batch over a
     # FIXED replica set; the session below keeps the driver live so a
     # controller can add/remove replicas while requests flow. Inline
-    # backend only today: process replicas already own the spawn/
-    # reload/re-warm machinery these seams reuse (the respawn path),
-    # but a dynamically-scaled process pool needs a driver->worker
-    # request channel the runtime does not have yet — stated in
-    # docs/AUTOSCALE.md, not hidden.
+    # replicas tick inside the driver's process; PROCESS replicas are
+    # worker groups of ``cfg.tp`` ranks fed over the request channel
+    # (serve/channel.py): submit/drain/stop commands flow IN over a
+    # per-replica command log, results and acks batch back over the
+    # side channel, and replica death replays the unfinished
+    # assignment on a fresh channel epoch (docs/SERVING.md "the
+    # request channel").
 
     def _require_session(self) -> None:
         if not self._session_active:
@@ -753,28 +1057,42 @@ class ServeDriver:
         return sum(1 for r in self.replicas.values()
                    if r.state == "draining")
 
-    def start(self) -> "ServeDriver":
+    def start(self, fault: Optional[dict] = None) -> "ServeDriver":
         """Open a dynamic serving session with ``cfg.n_replicas``
         replicas (each through `add_replica` — the scale-up path is the
         boot path). Requests then arrive via `submit()` and the caller
-        drives `tick()`; `stop()` drains and writes serving.json."""
-        if self.cfg.backend != "inline":
-            raise ValueError(
-                "dynamic serving sessions are inline-only today: "
-                "process replicas lack a driver->worker request "
-                "channel, so replica count is fixed for a process "
-                "run() (docs/AUTOSCALE.md 'limits')")
+        drives `tick()`; `stop()` drains and writes serving.json.
+
+        ``backend="process"``: each replica is a worker group fed over
+        the request channel (serve/channel.py) — submit/drain/stop
+        commands flow in over a per-replica command log, results and
+        acks batch back over the side channel, and replica death
+        replays the unfinished assignment on a fresh channel epoch.
+        ``fault`` (process only): ``{"replica": r, "kill_after_tokens":
+        n}`` SIGKILLs replica ``r``'s leader once, mid-stream — the
+        session twin of `run()`'s recovery drill."""
         if self._session_active:
             raise RuntimeError("session already started")
-        from ray_lightning_tpu.models.llama import Llama
-
+        if fault and self.cfg.backend != "process":
+            raise ValueError("fault injection needs backend='process' "
+                             "— a replica must die for real to drill "
+                             "recovery")
         if self.cfg.compile_cache_dir:
             from ray_lightning_tpu.pipeline.compile_cache import (
                 enable_persistent_cache,
             )
 
             enable_persistent_cache(self.cfg.compile_cache_dir)
-        self._model = Llama(self.model_cfg)
+        if self.cfg.backend == "inline":
+            from ray_lightning_tpu.models.llama import Llama
+
+            self._model = Llama(self.model_cfg)
+        else:
+            self._session_dir = self.cfg.run_dir or os.path.join(
+                os.getcwd(), "rlt_logs", "serve")
+            os.makedirs(self._session_dir, exist_ok=True)
+            self._session_fault = fault
+            self._proc_lock = san_lock("serve.driver.session")
         self._session_active = True
         self.replicas = {}
         self._next_replica = 0
@@ -844,6 +1162,8 @@ class ServeDriver:
                 r, "injected spawn fault: replica worker killed "
                    "during warmup (autoscale drill)",
                 signal_name=fault["signal_name"], cause="signal")
+        if self.cfg.backend == "process":
+            return self._add_replica_process(r)
         t0 = time.perf_counter()
         params = (load_params_npz(self.params_path)
                   if self.params_path is not None else self.params)
@@ -887,6 +1207,13 @@ class ServeDriver:
         replica-death replay) and stop immediately. Returns the victim
         id (default: the newest live replica)."""
         self._require_session()
+        if self.cfg.backend == "process":
+            sends: list = []
+            with self._proc_lock:
+                victim = self._remove_replica_process(replica, graceful,
+                                                      sends)
+            self._flush_sends(sends)
+            return victim
         if replica is None:
             live = self.live_ids
             if not live:
@@ -936,6 +1263,16 @@ class ServeDriver:
         req = dataclasses.replace(req)
         if req.arrival == 0.0:
             req.arrival = time.perf_counter()
+        if self.cfg.backend == "process":
+            # the side-channel fan-in threads mutate outputs/assigned
+            # under the same lock; the channel append itself happens
+            # after the lock drops
+            sends: list = []
+            with self._proc_lock:
+                self.outputs.setdefault(req.rid, [])
+                target = self._route(req, 0, sends)
+            self._flush_sends(sends)
+            return target
         self.outputs.setdefault(req.rid, [])
         return self._route(req, 0)
 
@@ -944,8 +1281,30 @@ class ServeDriver:
         requests to any live replica, evict draining replicas' queues
         onto survivors, tick every non-stopped replica, retire drains
         that completed. Idle live replicas still tick (their gauges
-        keep the load signal honest about spare capacity)."""
+        keep the load signal honest about spare capacity).
+
+        Process backend: replicas tick themselves (the worker's own
+        loop) — the driver's tick flushes deferred requests, surfaces
+        any terminal replica error, and stamps the driver gauges;
+        completions land in ``.meta``/``.outputs`` asynchronously and
+        the return value is always empty."""
         self._require_session()
+        if self.cfg.backend == "process":
+            sends: list = []
+            with self._proc_lock:
+                for rep in self.replicas.values():
+                    if rep.error is not None:
+                        raise rep.error
+                self._route_pending(sends)
+                self._session_ticks += 1
+                dm = self.driver_metrics
+                if dm.enabled:
+                    dm.gauge("replicas_live", self.n_live)
+                    dm.gauge("replicas_draining", self.n_draining)
+                    dm.gauge("pending_requests", len(self.pending))
+                    dm.tick_end()
+            self._flush_sends(sends)
+            return []
         self._route_pending()
         done: List[Completion] = []
         for r in sorted(self.replicas):
@@ -995,6 +1354,11 @@ class ServeDriver:
 
     def busy(self) -> bool:
         self._require_session()
+        if self.cfg.backend == "process":
+            with self._proc_lock:
+                return bool(self.pending) or any(
+                    rep.assigned for rep in self.replicas.values()
+                    if rep.state != "stopped")
         return bool(self.pending) or any(
             rep.sched.busy() for rep in self.replicas.values()
             if rep.state != "stopped")
@@ -1013,7 +1377,12 @@ class ServeDriver:
         for rep in self.replicas.values():
             if rep.state == "stopped":
                 continue
-            fl = rep.sched.flight
+            sched = getattr(rep, "sched", None)
+            if sched is None:
+                # process replicas persist worker-side on their own
+                # cadence; the driver holds no ring for them
+                continue
+            fl = sched.flight
             if getattr(fl, "enabled", False):
                 fl.persist()
                 persisted += 1
@@ -1029,6 +1398,8 @@ class ServeDriver:
         inflight-tagged spans and stops cold. Writes serving.json and
         returns the session's ServeResult."""
         self._require_session()
+        if self.cfg.backend == "process":
+            return self._stop_process(drain)
         if drain:
             while self.busy():
                 # work can defer INTO pending mid-drain (a draining
@@ -1092,7 +1463,8 @@ class ServeDriver:
         self._rr += 1
         return target
 
-    def _route(self, req: Request, preempts: int) -> Optional[int]:
+    def _route(self, req: Request, preempts: int,
+               sends: Optional[list] = None) -> Optional[int]:
         target = self._pick_replica()
         if target is None:
             self.pending.append((req, preempts))
@@ -1109,13 +1481,38 @@ class ServeDriver:
                                       draining=self.n_draining,
                                       pending=len(self.pending))
             return None
-        self.replicas[target].sched.enqueue(req, preempts)
+        rep = self.replicas[target]
+        if isinstance(rep, _ProcessReplica):
+            from ray_lightning_tpu.serve.channel import request_to_wire
+
+            # the command log IS the enqueue; the driver's assignment
+            # ledger is what the respawn replay is computed from. The
+            # send itself is DEFERRED to after the session lock drops
+            # (_flush_sends) — every process-path caller passes `sends`
+            rep.assigned.append(req)
+            sends.append((rep.writer, rep.writer.epoch, "submit",
+                          {"req": request_to_wire(req),
+                           "preempts": preempts}))
+        else:
+            rep.sched.enqueue(req, preempts)
         return target
 
-    def _route_pending(self) -> None:
+    def _route_pending(self, sends: Optional[list] = None) -> None:
         while self.pending and self.live_ids:
             req, preempts = self.pending.popleft()
-            self._route(req, preempts)
+            self._route(req, preempts, sends)
+
+    @staticmethod
+    def _flush_sends(sends: list) -> None:
+        """Perform channel sends decided under the session lock, OUTSIDE
+        it — the command log's per-append fsync must not serialize the
+        whole driver (threadcheck RLT705). Each send is epoch-guarded:
+        if its replica respawned between the locked decision and this
+        append, the fresh epoch's replay already carries the command
+        (computed from the same locked state), so `send_at` drops it
+        instead of duplicating the stream."""
+        for writer, epoch, op, payload in sends:
+            writer.send_at(epoch, op, **payload)
 
     def _requeue_from(self, rep: "_Replica") -> None:
         for req, preempts in rep.sched.evict_queued():
@@ -1156,6 +1553,315 @@ class ServeDriver:
         self.driver_metrics.count("replicas_stopped")
         self.driver_flight.record("drain_end", replica=rep.id,
                                   live=self.n_live)
+
+    # ---- process-session internals (the request channel) ------------------
+
+    def _add_replica_process(self, r: int) -> int:
+        """Spawn one PROCESS replica: open its command log, start its
+        spawn/respawn thread, and block until the worker group reports
+        live (or the spawn classifies terminal)."""
+        import threading
+
+        from ray_lightning_tpu.serve.channel import ChannelWriter
+
+        with self._proc_lock:
+            writer = ChannelWriter(self._session_dir, r)
+            rep = _ProcessReplica(r, writer)
+            self._next_replica += 1
+            self.replicas[r] = rep
+            rep.thread = threading.Thread(
+                target=self._run_session_replica, args=(rep,),
+                daemon=True, name=f"serve-replica-{r}")
+            rep.thread.start()
+        if not rep.live_evt.wait(self.cfg.start_timeout):
+            with self._proc_lock:
+                rep.state = "stopped"
+            raise RuntimeError(
+                f"replica {r} did not report live within "
+                f"{self.cfg.start_timeout:.0f}s (spawn/warmup hang) — "
+                f"worker logs under {self._session_dir}/replica{r}")
+        with self._proc_lock:
+            if rep.error is not None:
+                raise rep.error
+            self.driver_metrics.count("replicas_spawned")
+            self.driver_flight.record(
+                "spawn", replica=r,
+                warm_s=round(rep.warm_s or 0.0, 4), live=self.n_live)
+        # no _rebalance across process replicas: queued work already
+        # shipped over a channel cannot be pulled back without an
+        # evict-back command (docs/SERVING.md "sharded replicas") —
+        # NEW submissions round-robin onto the grown set immediately
+        return r
+
+    def _remove_replica_process(self, replica: Optional[int],
+                                graceful: bool, sends: list) -> int:
+        """Caller holds ``_proc_lock``. The drain/stop command does the
+        rest: the worker evicts what the survivors should replay (its
+        queue; plus its slots when not graceful), wires the evictions
+        back in its final batch items, and exits; the spawn thread then
+        flips the replica to stopped."""
+        if replica is None:
+            live = self.live_ids
+            if not live:
+                raise RuntimeError("no live replica to remove")
+            replica = live[-1]
+        rep = self.replicas.get(replica)
+        if rep is None or rep.state != "live":
+            raise ValueError(
+                f"replica {replica} is "
+                f"{'unknown' if rep is None else rep.state} — only a "
+                "live replica can be removed")
+        rep.state = "draining"
+        self.driver_metrics.count("replicas_drain_begun")
+        self.driver_flight.record(
+            "drain_begin", replica=replica, graceful=graceful,
+            outstanding=len(rep.assigned))
+        if graceful:
+            sends.append((rep.writer, rep.writer.epoch, "drain", {}))
+        else:
+            sends.append((rep.writer, rep.writer.epoch, "stop",
+                          {"mode": "hard"}))
+        return replica
+
+    def _run_session_replica(self, rep: "_ProcessReplica") -> None:
+        """One replica's spawn/respawn loop (its own thread, mirroring
+        `_run_process.run_replica`): compute the channel-epoch replay,
+        run the WorkerGroup of ``cfg.tp`` ranks as an SPMD program,
+        classify deaths via `resilience.policy`, respawn the WHOLE
+        group within the restart budget."""
+        from ray_lightning_tpu.resilience.policy import classify_failure
+        from ray_lightning_tpu.runtime.group import (
+            WorkerGroup, find_free_port,
+        )
+        from ray_lightning_tpu.runtime.launch import _spmd_main
+        from ray_lightning_tpu.serve.channel import request_to_wire
+
+        cfgkw = dataclasses.asdict(self.model_cfg)
+        cfgkw["dtype"] = np.dtype(self.model_cfg.dtype).name
+        enginekw = dataclasses.asdict(self.cfg.engine)
+        tp = self.cfg.tp
+        fault = getattr(self, "_session_fault", None)
+        rep_fault = (fault if fault and
+                     fault.get("replica", 0) == rep.id else None)
+        while True:
+            with self._proc_lock:
+                if rep.attempts > 0:
+                    # respawn: a FRESH epoch replaying the unfinished
+                    # assignment + control state. Partial streams drop
+                    # here — the replay regenerates them bitwise from
+                    # the per-request seeds (scheduler purity)
+                    rep.assigned = [q for q in rep.assigned
+                                    if q.rid not in self.meta]
+                    # partial prefixes are NOT cleared here: the
+                    # respawned worker announces every replayed submit
+                    # it admits ("starts" in its first batch) and the
+                    # fan-in resets the stream there — keeps this
+                    # thread's hands off the driver's result dicts
+                    replay = [{"op": "submit", "req": request_to_wire(q)}
+                              for q in rep.assigned]
+                    if rep.state == "draining":
+                        replay.append({"op": "drain"})
+                    rep.writer.begin_epoch(replay)
+                epoch = rep.writer.epoch
+            group = WorkerGroup(
+                num_workers=tp, env=dict(self.cfg.env or {}),
+                log_dir=os.path.join(self._session_dir,
+                                     f"replica{rep.id}"),
+                start_timeout=self.cfg.start_timeout)
+            try:
+                group.start()
+                coordinator = f"127.0.0.1:{find_free_port()}"
+                res = group.run(
+                    _spmd_main,
+                    shared_args=(
+                        _replica_session_main,
+                        (dict(cfgkw), self.params_path, dict(enginekw),
+                         self.cfg.reserve, rep.id, self.cfg.run_dir,
+                         self._session_dir, self.cfg.compile_cache_dir,
+                         rep_fault, self._session_dir,
+                         self._metrics_cfg(), epoch, tp),
+                        {}, tp, coordinator, self.cfg.platform,
+                        self.cfg.cpu_devices_per_rank),
+                    per_rank_args=[(k, (k,)) for k in range(tp)],
+                    on_queue_item=self._on_session_item)
+                with self._proc_lock:
+                    rep.result = res[0]
+                    if rep.state != "stopped":
+                        self._finalize_process_replica(rep)
+                return
+            except Exception as exc:  # noqa: BLE001 — classified below
+                fc = classify_failure(exc)
+                log.warning(
+                    "session replica %d died (%s/%s): %s", rep.id,
+                    fc.kind, fc.cause, fc.detail)
+                with self._proc_lock:
+                    respawning = (fc.restartable
+                                  and rep.restarts < self.cfg.max_restarts
+                                  and rep.state != "stopped")
+                    if self.cfg.run_dir and self.cfg.metrics:
+                        from ray_lightning_tpu.telemetry.metrics import (
+                            finalize_flight,
+                        )
+
+                        finalize_flight(
+                            os.path.join(self.cfg.run_dir, "telemetry"),
+                            rep.id,
+                            {"kind": fc.kind, "cause": fc.cause,
+                             "detail": fc.detail,
+                             "restartable": fc.restartable,
+                             "restarts_so_far": rep.restarts,
+                             "respawning": respawning},
+                            os.path.join(self.cfg.run_dir,
+                                         "flight.json"))
+                    rep.attempts += 1
+                    if not respawning:
+                        rep.error = exc
+                        rep.state = "stopped"
+                        rep.live_evt.set()
+                        return
+                    rep.restarts += 1
+                    rep.live_evt.clear()
+            finally:
+                group.shutdown()
+
+    def _on_session_item(self, _rank, item) -> None:
+        """Side-channel fan-in for every session replica (called from
+        their spawn threads): one BATCHED item per worker tick —
+        tokens, acks, completions, evictions together (the channel's
+        RLT504 discipline)."""
+        from ray_lightning_tpu.serve.channel import request_from_wire
+
+        kind = item[0]
+        sends: list = []
+        with self._proc_lock:
+            rep = self.replicas.get(item[1])
+            if rep is None:
+                return
+            if kind == "live":
+                w = item[2]["warmup_s"]
+                rep.warm_s = w
+                rep.warmups.append(w)
+                rep.spawned_at = time.perf_counter()
+                self.last_spawn_s = w
+                rep.live_evt.set()
+                return
+            if kind != "batch":
+                return
+            payload = item[2]
+            if "ack" in payload:
+                rep.acked = max(rep.acked, int(payload["ack"]))
+            for rid in payload.get("starts", ()):
+                # the worker admitted this submit afresh — on a normal
+                # submit a no-op reset, on an epoch replay after respawn
+                # THE reset that drops the dead epoch's partial prefix
+                # (the stream regenerates bitwise from its seed).
+                # Ordered before toks: a replayed stream's first tokens
+                # can share this batch
+                self.outputs[rid] = []
+            for rid in payload.get("preempts", ()):
+                # scheduler-level preemption: the replay resends the
+                # stream from scratch — drop the prefix
+                self.outputs[rid] = []
+            for rid, tok in payload.get("toks", ()):
+                self.outputs[rid].append(int(tok))
+                self._session_tokens += 1
+            for rid, m in payload.get("dones", ()):
+                self.meta[rid] = {"replica": rep.id, **m}
+                rep.assigned = [q for q in rep.assigned
+                                if q.rid != rid]
+            for wire, preempts in payload.get("evicted", ()):
+                # a draining/stopping replica handing work back for
+                # the survivors (bitwise replay seam)
+                req = request_from_wire(wire)
+                rep.assigned = [q for q in rep.assigned
+                                if q.rid != req.rid]
+                self.outputs[req.rid] = []
+                self._route(req, int(preempts), sends)
+        self._flush_sends(sends)
+
+    def _finalize_process_replica(self, rep: "_ProcessReplica") -> None:
+        """Worker group exited cleanly (caller holds ``_proc_lock``).
+        The worker owned and closed the replica's telemetry streams —
+        the driver only flips state and stamps its own records."""
+        rep.state = "stopped"
+        self.driver_metrics.count("replicas_stopped")
+        self.driver_flight.record("drain_end", replica=rep.id,
+                                  live=self.n_live)
+
+    def _stop_process(self, drain: bool) -> ServeResult:
+        if drain:
+            while self.busy():
+                with self._proc_lock:
+                    others_busy = any(
+                        rep.assigned for rep in self.replicas.values()
+                        if rep.state != "stopped")
+                    if (self.pending and self.n_live == 0
+                            and not others_busy):
+                        raise RuntimeError(
+                            f"{len(self.pending)} deferred request(s) "
+                            "with no live replica — add_replica() "
+                            "before stop(), or stop(drain=False) to "
+                            "abandon them")
+                self.tick()
+                time.sleep(0.01)
+        sends: list = []
+        with self._proc_lock:
+            final_replicas = self.n_live
+            for rep in self.replicas.values():
+                if rep.state != "stopped":
+                    # "finish": serve out everything assigned, then
+                    # exit; "abort": account in-flight work as
+                    # inflight-tagged spans and exit now
+                    sends.append(
+                        (rep.writer, rep.writer.epoch, "stop",
+                         {"mode": "finish" if drain else "abort"}))
+        self._flush_sends(sends)
+        for rep in self.replicas.values():
+            if rep.thread is not None:
+                rep.thread.join(self.cfg.start_timeout)
+        for rep in self.replicas.values():
+            if rep.error is not None:
+                raise rep.error
+        wall = time.perf_counter() - self._session_t0
+        results = [rep.result for rep in self.replicas.values()
+                   if rep.result]
+        occ = [res["occupancy"] for res in results]
+        warm_all = [w for rep in self.replicas.values()
+                    for w in rep.warmups]
+        stats = {
+            "decode_tokens_per_s":
+                self._session_tokens / max(wall, 1e-9),
+            "slot_occupancy": (float(np.mean(occ)) if occ else None),
+            "n_requests": len(self.outputs),
+            "n_tokens": self._session_tokens,
+            "wall_s": wall,
+            "ticks": self._session_ticks,
+            "compile_count": max(
+                (res["compile_count"] for res in results),
+                default=None),
+            "replicas_spawned": self._next_replica,
+            "final_replicas": final_replicas,
+            "warmup_cold_s": warm_all[0] if warm_all else None,
+            "warmup_respawn_s": (max(warm_all[1:])
+                                 if len(warm_all) > 1 else None),
+            "restarts_total": sum(rep.restarts
+                                  for rep in self.replicas.values()),
+            "submit_deferrals":
+                self.driver_metrics.counters().get(
+                    "submit_deferrals", 0),
+            "last_spawn_s": self.last_spawn_s,
+        }
+        result = ServeResult(
+            outputs=self.outputs, meta=self.meta,
+            restarts={rep.id: rep.restarts
+                      for rep in self.replicas.values()}, stats=stats)
+        for rep in self.replicas.values():
+            rep.writer.close()
+        self.driver_metrics.close()
+        self.driver_flight.close()
+        self._write_summary(result)
+        self._session_active = False
+        return result
 
     def _write_summary(self, result: ServeResult) -> None:
         if self.cfg.run_dir is None:

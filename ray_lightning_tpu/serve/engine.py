@@ -52,7 +52,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ray_lightning_tpu.serve.kv_cache import PagedPoolSpec, init_pool
+from ray_lightning_tpu.serve.kv_cache import (
+    PagedPoolSpec,
+    init_pool,
+    pool_partition_spec,
+    validate_pool_tp,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -465,6 +470,72 @@ def idle_prefill(cfg: EngineConfig):
             np.int32(0), np.int32(-1), np.zeros(B, np.int32))
 
 
+def _global_put(x, sharding):
+    """Place a host array as a GLOBAL jax array under ``sharding`` —
+    single- or multi-process alike. Every process holds the full value
+    (params come off the same npz, runtime inputs off the same
+    lockstep scheduler), so each process carves out its addressable
+    devices' slices and assembles the global view — the
+    `resilience.faults` respawn-placement idiom. `jax.device_put`
+    cannot do this cross-process in general (non-addressable devices),
+    and `make_array_from_process_local_data` expects per-process
+    SHARDS, not the replicated whole."""
+    x = np.asarray(x)
+    idx_map = sharding.addressable_devices_indices_map(x.shape)
+    arrs = [jax.device_put(x[idx], d) for d, idx in idx_map.items()]
+    return jax.make_array_from_single_device_arrays(
+        x.shape, sharding, arrs)
+
+
+def serving_param_specs(model, params, axis_names):
+    """Per-leaf ``(path, PartitionSpec)`` list (tree_leaves order) for
+    a replica's weights: the model's published per-leaf specs
+    (`model.param_specs`, e.g. `models.llama.llama_param_specs` —
+    wqkv/gate_up column-split, wo/w_down row-split, embeddings
+    vocab-split) looked up by exact leaf path, every unknown leaf
+    REPLICATED. Specs naming axes outside ``axis_names`` fall back to
+    replicated too — serving meshes are tensor-only. Shared by the
+    engine's device placement and `serve.audit`'s collective pricing,
+    so the audited layout IS the served one."""
+    from jax.sharding import PartitionSpec
+
+    from ray_lightning_tpu.utils.pytree import named_leaves
+
+    if hasattr(model, "param_specs"):       # the trainer-side wrapper
+        specs = model.param_specs(params)
+    elif hasattr(model, "cfg"):
+        # the flax module the engine serves: the published llama
+        # placement keyed off its config
+        from ray_lightning_tpu.models.llama import llama_param_specs
+
+        specs = llama_param_specs(model.cfg)
+    else:
+        specs = {}
+    axes = set(axis_names)
+    out = []
+    for path, _ in named_leaves(params):
+        spec = specs.get(path)
+        if spec is None or any(
+                ax not in axes
+                for entry in tuple(spec) if entry is not None
+                for ax in ((entry,) if isinstance(entry, str) else entry)):
+            spec = PartitionSpec()
+        out.append((path, spec))
+    return out
+
+
+def serving_param_shardings(model, params, mesh):
+    """`serving_param_specs` as per-leaf NamedShardings on the
+    replica's own mesh (the pytree `DecodeEngine` places weights
+    with)."""
+    from jax.sharding import NamedSharding
+
+    flat = [NamedSharding(mesh, spec) for _, spec in
+            serving_param_specs(model, params, mesh.axis_names)]
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(params), flat)
+
+
 class DecodeEngine:
     """One replica's compiled step + its device-resident buffers.
 
@@ -476,7 +547,7 @@ class DecodeEngine:
     def __init__(self, model, params, cfg: EngineConfig,
                  max_seq_len_check: bool = True,
                  use_pallas: Optional[bool] = None,
-                 metrics=None):
+                 metrics=None, mesh=None):
         if max_seq_len_check and cfg.max_slot_len > model.cfg.max_seq_len:
             raise ValueError(
                 f"engine max_slot_len {cfg.max_slot_len} exceeds the "
@@ -511,36 +582,73 @@ class DecodeEngine:
             (cfg.prefill_batch, cfg.prefill_chunk, model.cfg.n_heads,
              model.cfg.head_dim),
             pool_shape, use_pallas)
-        # canonicalize the weights' placement: trainer-produced params
-        # arrive committed to a NamedSharding over the training mesh,
-        # and a step closed over those emits NamedSharding outputs —
-        # so the donated pool buffers (built SingleDeviceSharding by
-        # init_pool) change signature after the first tick and the step
-        # compiles a SECOND executable (observed in the
-        # fine-tune -> serve flow; test-pinned). Committing the weights
-        # to one concrete device keeps every signature
-        # SingleDeviceSharding from the first tick on — one replica is
-        # one model copy today (sharded replicas are the roadmap's
-        # elastic-scale follow-up, docs/SERVING.md).
-        self.params = jax.device_put(params, jax.devices()[0])
         self.cfg = cfg
         self.spec = cfg.pool_spec
-        self._step = jax.jit(build_step(model, cfg, fused=self.fused,
-                                        fused_prefill=self.fused_prefill),
-                             donate_argnums=(1, 2, 3))
-        # COMMIT the device-resident buffers to the same device as the
-        # weights: a fresh jnp.zeros is uncommitted, but the step's
-        # outputs are committed, so an uncommitted first-tick signature
-        # would compile a second executable the moment the donated
-        # outputs cycle back in (same phantom-recompile class as the
-        # params placement above; the churn pin covers both)
-        device = jax.devices()[0]
-        pool_k, pool_v = init_pool(model.cfg, self.spec)
-        self.pool_k = jax.device_put(pool_k, device)
-        self.pool_v = jax.device_put(pool_v, device)
-        self.last_logits = jax.device_put(
-            jnp.zeros((cfg.capacity, model.cfg.vocab_size), jnp.float32),
-            device)
+        #: replica-group mesh (docs/SERVING.md "sharded replicas"):
+        #: None = the historical single-device replica; a mesh with a
+        #: ``tensor`` axis lowers the SAME one-compile step as an SPMD
+        #: program — params shard per `models.llama.llama_param_specs`,
+        #: the pool shards over KV heads, and every runtime input +
+        #: sampled output stays replicated so the host-side scheduler
+        #: (which lives on every rank, lockstep) is tp-oblivious.
+        self.mesh = mesh
+        self.tp = 1 if mesh is None else int(mesh.shape.get("tensor", 1))
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            validate_pool_tp(model.cfg, self.tp)
+            self._repl_sh = NamedSharding(mesh, PartitionSpec())
+            pool_sh = NamedSharding(mesh,
+                                    pool_partition_spec(self.tp))
+            param_sh = serving_param_shardings(model, params, mesh)
+            self.params = jax.tree_util.tree_map(_global_put, params,
+                                                 param_sh)
+            # out shardings pin the donated-buffer cycle: pool in/out
+            # identical (donation holds), logits + rngs + emitted
+            # replicated so every rank reads the same host values
+            self._step = jax.jit(
+                build_step(model, cfg, fused=self.fused,
+                           fused_prefill=self.fused_prefill),
+                donate_argnums=(1, 2, 3),
+                out_shardings=(pool_sh, pool_sh, self._repl_sh,
+                               self._repl_sh, self._repl_sh))
+            pool_k, pool_v = init_pool(model.cfg, self.spec)
+            self.pool_k = _global_put(pool_k, pool_sh)
+            self.pool_v = _global_put(pool_v, pool_sh)
+            self.last_logits = _global_put(
+                jnp.zeros((cfg.capacity, model.cfg.vocab_size),
+                          jnp.float32), self._repl_sh)
+        else:
+            # canonicalize the weights' placement: trainer-produced
+            # params arrive committed to a NamedSharding over the
+            # training mesh, and a step closed over those emits
+            # NamedSharding outputs — so the donated pool buffers
+            # (built SingleDeviceSharding by init_pool) change
+            # signature after the first tick and the step compiles a
+            # SECOND executable (observed in the fine-tune -> serve
+            # flow; test-pinned). Committing the weights to one
+            # concrete device keeps every signature
+            # SingleDeviceSharding from the first tick on.
+            self.params = jax.device_put(params, jax.devices()[0])
+            self._step = jax.jit(
+                build_step(model, cfg, fused=self.fused,
+                           fused_prefill=self.fused_prefill),
+                donate_argnums=(1, 2, 3))
+            # COMMIT the device-resident buffers to the same device as
+            # the weights: a fresh jnp.zeros is uncommitted, but the
+            # step's outputs are committed, so an uncommitted
+            # first-tick signature would compile a second executable
+            # the moment the donated outputs cycle back in (same
+            # phantom-recompile class as the params placement above;
+            # the churn pin covers both)
+            device = jax.devices()[0]
+            pool_k, pool_v = init_pool(model.cfg, self.spec)
+            self.pool_k = jax.device_put(pool_k, device)
+            self.pool_v = jax.device_put(pool_v, device)
+            self.last_logits = jax.device_put(
+                jnp.zeros((cfg.capacity, model.cfg.vocab_size),
+                          jnp.float32),
+                device)
         self.steps = 0
         # live metrics (telemetry/metrics.py): per-tick prefill/decode
         # token counts + the compile counter. The registry NEVER enters
@@ -605,21 +713,30 @@ class DecodeEngine:
         ([C] i32 per-slot left pad) exists only on the batched-prefill
         program (prefill_batch > 1) and is ignored otherwise — the
         single-slot program is the historical one, with no pad inputs."""
+        if self.mesh is None:
+            put = jnp.asarray
+        else:
+            # every runtime input is replicated over the replica's own
+            # mesh: each rank computed the SAME host values (lockstep
+            # scheduler), so assembling the global view is pure
+            # placement, no wire traffic
+            def put(x):
+                return _global_put(x, self._repl_sh)
         common = (
             self.params, self.pool_k, self.pool_v, self.last_logits,
-            jnp.asarray(tables), jnp.asarray(pos), jnp.asarray(decoding),
-            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(rngs))
+            put(tables), put(pos), put(decoding),
+            put(temp), put(top_k), put(rngs))
         if self.cfg.prefill_batch == 1:
             pslot, ptoks, ppos, plast = prefill
-            args = common + (jnp.asarray(pslot), jnp.asarray(ptoks),
-                             jnp.asarray(ppos), jnp.asarray(plast))
+            args = common + (put(pslot), put(ptoks),
+                             put(ppos), put(plast))
         else:
             if pad is None:
                 pad = np.zeros(self.cfg.capacity, np.int32)
             pslot, ptoks, ppos, plast, ppad = prefill
-            args = common + (jnp.asarray(pad), jnp.asarray(pslot),
-                             jnp.asarray(ptoks), jnp.asarray(ppos),
-                             jnp.asarray(plast), jnp.asarray(ppad))
+            args = common + (put(pad), put(pslot),
+                             put(ptoks), put(ppos),
+                             put(plast), put(ppad))
         (self.pool_k, self.pool_v, self.last_logits, new_rngs,
          emitted) = self._step(*args)
         self.steps += 1
@@ -642,4 +759,10 @@ class DecodeEngine:
                         n_pf_rows * self.cfg.prefill_chunk)
             m.gauge("engine_steps", self.steps)
             m.gauge("compile_count", self.compile_count)
+        if self.mesh is not None:
+            # replicated outputs: any addressable shard IS the global
+            # value — np.array on a multi-process global array would
+            # raise (non-addressable devices)
+            return (np.array(emitted.addressable_data(0)),
+                    np.array(new_rngs.addressable_data(0)))
         return np.array(emitted), np.array(new_rngs)

@@ -85,6 +85,41 @@ def init_pool(cfg, spec: PagedPoolSpec):
     return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
 
 
+def validate_pool_tp(cfg, tp: int) -> None:
+    """A tensor-parallel replica shards the pool over the KV-head axis
+    (the one axis every pool consumer — gather, scatter, both fused
+    kernels — treats as embarrassingly parallel), so the head count
+    must divide evenly: an uneven split would give ranks different
+    pool shapes and the one-compile step different programs per rank."""
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    if cfg.n_kv_heads % tp:
+        raise ValueError(
+            f"tensor-parallel degree {tp} must divide n_kv_heads "
+            f"{cfg.n_kv_heads}: the paged pool shards over the KV-head "
+            "axis (docs/SERVING.md 'sharded replicas')")
+
+
+def pool_partition_spec(tp: int = 1):
+    """PartitionSpec of one pool leaf ``[L, n_blocks, block_size, Hkv,
+    hd]`` on a replica's own mesh: KV heads over the ``tensor`` axis,
+    every other axis replicated. Block identity is untouched — the SAME
+    host-side block table drives every shard, so the allocator and the
+    scheduler stay tp-oblivious."""
+    from jax.sharding import PartitionSpec as P
+
+    if tp <= 1:
+        return P()
+    return P(None, None, None, "tensor", None)
+
+
+def pool_shard_bytes(cfg, spec: PagedPoolSpec, tp: int = 1) -> int:
+    """Per-device HBM of one rank's pool shard (k + v): the head axis
+    divides by ``tp``, everything else is carried whole."""
+    validate_pool_tp(cfg, tp)
+    return int(pool_bytes(cfg, spec)) // tp
+
+
 def pool_bytes(cfg, spec: PagedPoolSpec) -> int:
     """HBM held by the pool itself (k + v)."""
     per = (cfg.n_layers * spec.n_blocks * spec.block_size
@@ -109,7 +144,8 @@ def gathered_view_bytes(cfg, spec: PagedPoolSpec, capacity: int) -> int:
 def serve_kv_plan_bytes(cfg, spec: PagedPoolSpec, capacity: int,
                         fused: bool = False,
                         prefill_batch: int = 1,
-                        fused_prefill: bool = False) -> dict:
+                        fused_prefill: bool = False,
+                        tp: int = 1) -> dict:
     """The serving cache's HBM story for the ``plan --serve`` leg:
     itemized pool + gathered view + the per-slot logits buffer the
     engine keeps device-resident between steps.
@@ -122,11 +158,17 @@ def serve_kv_plan_bytes(cfg, spec: PagedPoolSpec, capacity: int,
     separately as ``prefill_gather_bytes``; with the fused PREFILL
     kernel that last copy vanishes too and the view term reaches
     zero. The retired bytes are itemized so `plan --serve` can state
-    the per-replica HBM the kernels bought back."""
-    logits = capacity * cfg.vocab_size * 4  # f32 last_logits
-    dense = int(gathered_view_bytes(cfg, spec, capacity))
+    the per-replica HBM the kernels bought back.
+
+    ``tp > 1`` prices ONE RANK of a tensor-parallel replica: the pool
+    and every gathered view carry the KV-head axis and divide by
+    ``tp``; ``last_logits`` is replicated per rank (docs/SERVING.md
+    "sharded replicas") and does not."""
+    validate_pool_tp(cfg, tp)
+    logits = capacity * cfg.vocab_size * 4  # f32 last_logits, replicated
+    dense = int(gathered_view_bytes(cfg, spec, capacity)) // tp
     prefill_gather = int(gathered_view_bytes(
-        cfg, spec, min(prefill_batch, capacity)))
+        cfg, spec, min(prefill_batch, capacity))) // tp
     if fused_prefill:
         prefill_gather = 0
     if fused:
@@ -138,7 +180,7 @@ def serve_kv_plan_bytes(cfg, spec: PagedPoolSpec, capacity: int,
         view = dense
         prefill_gather = min(prefill_gather, view)
     return {
-        "pool_bytes": int(pool_bytes(cfg, spec)),
+        "pool_bytes": int(pool_bytes(cfg, spec)) // tp,
         "gathered_view_bytes": view,
         "gathered_view_retired_bytes": dense - view,
         "prefill_gather_bytes": prefill_gather,

@@ -98,6 +98,11 @@ STATIC = {"overlap_hidden_fraction"}
 #: nothing may quietly re-materialize the gather. Static class:
 #: ratchets on skip lines too; a line carrying the metric's waiver
 #: error field instead waives (analysis bug != regression).
+#: serve_decode_ici_bytes_per_tick is the flagship TP=2 sharded
+#: replica's decode-step collective traffic (ISSUE 18,
+#: serve/audit.py `audit_decode_step`): every byte rides the
+#: latency-critical per-token path (the layer psums + the jit-boundary
+#: logits gather), so the per-tick wire total may only shrink.
 #: low_precision_reductions is numcheck's count of narrow-accumulation
 #: findings on the flagship trace (RLT801 bf16 dot/reduce accumulations
 #: + RLT804 bf16 gradient collectives, analysis/numcheck.py): 0 since
@@ -106,6 +111,8 @@ STATIC = {"overlap_hidden_fraction"}
 CEILING = {"dcn_bytes_per_step": "dcn_bytes_per_step",
            "serve_hbm_bytes_per_replica": "serve_hbm_bytes_per_replica",
            "serve_prefill_gather_bytes": "serve_prefill_gather_bytes",
+           "serve_decode_ici_bytes_per_tick":
+               "serve_decode_ici_bytes_per_tick",
            "low_precision_reductions": "low_precision_reductions"}
 
 #: ceiling metric -> error fields whose presence waives an ABSENT
@@ -117,6 +124,8 @@ CEILING_WAIVERS = {
                                     "tracecheck_error"),
     "serve_prefill_gather_bytes": ("serving_error",
                                    "tracecheck_error"),
+    "serve_decode_ici_bytes_per_tick": ("serving_error",
+                                        "tracecheck_error"),
     "low_precision_reductions": ("numerics_error",),
 }
 
@@ -132,6 +141,10 @@ CEILING_WHY = {
         "the prefill lane's dense per-group gather is retired by the "
         "fused paged-prefill kernel — its bytes may only shrink, and "
         "nothing may quietly re-materialize the gather"),
+    "serve_decode_ici_bytes_per_tick": (
+        "decode collectives ride the latency-critical per-token path "
+        "(layer psums + the boundary logits gather) — the sharded "
+        "replica's per-tick wire bytes may only shrink"),
     "low_precision_reductions": (
         "the flagship step accumulates every long reduction in f32 "
         "(numcheck RLT801/RLT804) — the count is zero-anchored and no "

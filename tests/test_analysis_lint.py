@@ -740,3 +740,89 @@ def test_rlt601_suppressible():
         "def shard(global_batch):\n"
         "    return global_batch // 8  # rlt: disable=RLT601\n")
     assert "RLT601" not in rules_of(fs)
+
+
+# ---- RLT504 per-token channel chatter (serve/channel.py, -------------------
+# ---- docs/SERVING.md "the request channel") --------------------------------
+
+
+def test_rlt504_per_token_send_fires():
+    # the anti-pattern the batched side channel exists to prevent: one
+    # channel send per emitted token instead of one item per tick
+    fs = lint(
+        "def worker_loop(chan, engine):\n"
+        "    while True:\n"
+        "        emitted = engine.tick()\n"
+        "        for tok in emitted:\n"
+        "            chan.send(('tok', tok))\n")
+    assert "RLT504" in rules_of(fs)
+
+
+def test_rlt504_per_token_queue_put_fires():
+    fs = lint(
+        "def worker_loop(out_queue, engine):\n"
+        "    while True:\n"
+        "        toks = engine.tick()\n"
+        "        for i, t in enumerate(toks):\n"
+        "            out_queue.put_nowait(t)\n")
+    assert "RLT504" in rules_of(fs)
+
+
+def test_rlt504_per_token_recv_and_writer_forms_fire():
+    # the driver-side mirror (a recv/poll per expected token), and the
+    # channel writer spelling
+    fs = lint(
+        "def drain(conn, writer, emitted_tokens):\n"
+        "    for t in emitted_tokens:\n"
+        "        conn.recv()\n"
+        "    for t in emitted_tokens:\n"
+        "        writer.send('submit', tok=t)\n")
+    assert "RLT504" in rules_of(fs)
+
+
+def test_rlt504_batched_send_quiet():
+    # the sanctioned discipline: accumulate the tick's emissions, ONE
+    # side-channel item per iteration
+    fs = lint(
+        "def worker_loop(chan, engine):\n"
+        "    while True:\n"
+        "        emitted = engine.tick()\n"
+        "        batch = []\n"
+        "        for tok in emitted:\n"
+        "            batch.append(tok)\n"
+        "        chan.send(('toks', batch))\n")
+    assert "RLT504" not in rules_of(fs)
+
+
+def test_rlt504_quiet_on_non_channel_and_non_token_loops():
+    # a per-token loop touching no channel, and a channel loop not over
+    # tokens (command replay iterates COMMANDS — epoch-bounded, fine)
+    fs = lint(
+        "def decode(emitted, writer, replay):\n"
+        "    out = []\n"
+        "    for tok in emitted:\n"
+        "        out.append(tok)\n"
+        "    for cmd in replay:\n"
+        "        writer.send(cmd['op'])\n")
+    assert "RLT504" not in rules_of(fs)
+
+
+def test_rlt504_quiet_in_traced_code():
+    # inside jit there is no channel to chatter on — same scope rule as
+    # the other serve-loop lints
+    fs = lint(
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(tokens, chan_like):\n"
+        "    for t in tokens:\n"
+        "        chan_like.send(t)\n"
+        "    return tokens\n")
+    assert "RLT504" not in rules_of(fs)
+
+
+def test_rlt504_suppressible():
+    fs = lint(
+        "def worker_loop(chan, toks):\n"
+        "    for t in toks:\n"
+        "        chan.send(t)  # rlt: disable=RLT504\n")
+    assert "RLT504" not in rules_of(fs)

@@ -405,6 +405,21 @@ def test_bench_serve_summary_static():
         "ttft_cold_s", "ttft_warm_s", "ttft_p99_s", "slot_occupancy",
         "serving_attention_path", "serving_prefill_path",
         "serve_metrics", "scale_up_s", "autoscale"}
+    # the TP=2 sharded-replica section (ISSUE 18): per-shard HBM halves
+    # the replicated plan's params, and the decode collective schedule
+    # carries the gate-ratcheted per-tick wire total
+    tp = s["serve_tp"]
+    assert tp["tp"] == 2
+    # per-shard params: the sharded leaves halve, the (tiny) replicated
+    # norm scales don't — so just over full/2, never more than 51%
+    full = s["serving"]["flagship_plan"]["params_bytes"]
+    assert full / 2 <= tp["params_bytes_per_shard"] < full * 0.51
+    assert tp["hbm_bytes_per_shard"] < s["serve_hbm_bytes_per_replica"]
+    assert tp["decode_ici_bytes_per_tick"] == \
+        s["serve_decode_ici_bytes_per_tick"] == \
+        sum(c["wire_bytes"] for c in tp["collectives"]) > 0
+    kinds = {c["kind"] for c in tp["collectives"]}
+    assert "psum" in kinds and "all_gather" in kinds
 
 
 def test_bench_gate_ratchets_serving(tmp_path):
@@ -432,3 +447,19 @@ def test_bench_gate_ratchets_serving(tmp_path):
                for f in bench_gate.gate(laggy, best, tolerance=0.05))
     skip = {"metric": "m", "value": 0.0, "skipped": "backend unavailable"}
     assert bench_gate.gate(skip, best, tolerance=0.05) == []
+    # serve_decode_ici_bytes_per_tick CEILING-ratchets (static: holds on
+    # skip lines too); growth fails, a serving_error line waives absence
+    ceil = {"serve_decode_ici_bytes_per_tick": (1000.0, "BENCH_r09.json")}
+    flat = dict(skip, serve_decode_ici_bytes_per_tick=1000.0)
+    assert bench_gate.gate(flat, {}, tolerance=0.05, ceilings=ceil) == []
+    grew = dict(skip, serve_decode_ici_bytes_per_tick=2000.0)
+    assert any("serve_decode_ici_bytes_per_tick" in f
+               for f in bench_gate.gate(grew, {}, tolerance=0.05,
+                                        ceilings=ceil))
+    dropped = dict(skip)
+    assert any("dropped the field" in f
+               for f in bench_gate.gate(dropped, {}, tolerance=0.05,
+                                        ceilings=ceil))
+    waived = dict(skip, serving_error="IndexError: boom")
+    assert bench_gate.gate(waived, {}, tolerance=0.05,
+                           ceilings=ceil) == []

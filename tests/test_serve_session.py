@@ -1,0 +1,222 @@
+"""Dynamic-session tests for the driver→worker request channel
+(serve/channel.py, docs/SERVING.md "the request channel"): host-only
+command-log semantics fast (seqs, epochs, torn tails, the deferred-send
+epoch guard), then — slow, real processes — a 2-process TP=2 replica
+streaming bitwise against single-process `generate()` and the
+mid-stream SIGKILL drill respawning the WHOLE replica group with
+bitwise replay."""
+from __future__ import annotations
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.models.llama import generate
+from ray_lightning_tpu.serve.channel import (
+    ChannelReader,
+    ChannelWriter,
+    channel_dir,
+    epoch_path,
+    request_from_wire,
+    request_to_wire,
+)
+from ray_lightning_tpu.serve.driver import (
+    ReplicaGroupConfig,
+    ServeDriver,
+    save_params_npz,
+)
+from ray_lightning_tpu.serve.engine import EngineConfig
+from ray_lightning_tpu.serve.scheduler import Request
+
+# ---- host-only channel semantics ------------------------------------------
+
+
+def test_channel_seqs_monotonic_and_acked_batchwise(tmp_path):
+    w = ChannelWriter(tmp_path, 0)
+    r = ChannelReader(tmp_path, 0, 0)
+    assert r.poll() == []          # racing the first send: empty, not err
+    s1 = w.send("submit", req={"rid": "a"})
+    s2 = w.send("drain")
+    assert (s1, s2) == (1, 2) == (s1, w.last_seq)
+    cmds = r.poll()
+    assert [c["op"] for c in cmds] == ["submit", "drain"]
+    assert r.last_seq == 2         # ONE highest-seq ack per poll batch
+    assert r.poll() == []
+
+
+def test_channel_torn_tail_reads_as_nothing_new(tmp_path):
+    w = ChannelWriter(tmp_path, 0)
+    w.send("submit", req={"rid": "a"})
+    path = epoch_path(tmp_path, 0, 0)
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"seq": 2, "op": "dr')   # a torn mid-write line
+    r = ChannelReader(tmp_path, 0, 0)
+    assert [c["seq"] for c in r.poll()] == [1]
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('ain"}\n')                # the write completes
+    assert [c["op"] for c in r.poll()] == ["drain"]
+
+
+def test_channel_replay_safety_across_respawn(tmp_path):
+    """The respawn seam: begin_epoch seals the log and pre-populates the
+    next one with the unfinished assignment — a fresh reader at the new
+    epoch sees exactly the replay, seqs keep counting (never reused),
+    and the dead epoch's file is left intact for postmortems."""
+    w = ChannelWriter(tmp_path, 3)
+    for rid in ("a", "b", "c"):
+        w.send("submit", req={"rid": rid})
+    ChannelReader(tmp_path, 3, 0).poll()    # the doomed worker read these
+    epoch = w.begin_epoch([{"op": "submit", "req": {"rid": "b"}},
+                           {"op": "submit", "req": {"rid": "c"}},
+                           {"op": "drain"}])
+    assert epoch == w.epoch == 1
+    fresh = ChannelReader(tmp_path, 3, 1)
+    cmds = fresh.poll()
+    assert [c["op"] for c in cmds] == ["submit", "submit", "drain"]
+    assert [c["seq"] for c in cmds] == [4, 5, 6]
+    # post-respawn commands keep flowing on the same log
+    w.send("stop", mode="finish")
+    assert [c["seq"] for c in fresh.poll()] == [7]
+    assert epoch_path(tmp_path, 3, 0).exists()
+    assert sorted(p.name for p in channel_dir(tmp_path, 3).iterdir()) \
+        == ["epoch0.jsonl", "epoch1.jsonl"]
+
+
+def test_channel_send_at_drops_on_epoch_roll(tmp_path):
+    """The deferred-send guard: a send decided against an epoch that
+    rolled underneath (replica respawned between the driver's locked
+    decision and the append) is dropped — the new epoch's replay
+    already carries it, appending again would duplicate the stream."""
+    w = ChannelWriter(tmp_path, 0)
+    assert w.send_at(0, "submit", req={"rid": "a"}) == 1
+    w.begin_epoch([{"op": "submit", "req": {"rid": "a"}}])
+    assert w.send_at(0, "submit", req={"rid": "a"}) is None   # stale
+    assert w.send_at(1, "drain") == 3                         # current
+    cmds = ChannelReader(tmp_path, 0, 1).poll()
+    assert [(c["seq"], c["op"]) for c in cmds] \
+        == [(2, "submit"), (3, "drain")]
+
+
+def test_channel_follower_take_upto_buffers_newer(tmp_path):
+    """A follower consumes exactly the leader's journaled prefix,
+    buffering newer commands for the next lockstep iteration."""
+    w = ChannelWriter(tmp_path, 0)
+    for rid in ("a", "b", "c"):
+        w.send("submit", req={"rid": rid})
+    r = ChannelReader(tmp_path, 0, 0)
+    assert [c["seq"] for c in r.take_upto(2)] == [1, 2]
+    assert r.last_seq == 2
+    assert [c["seq"] for c in r.take_upto(3)] == [3]
+    assert r.take_upto(3) == []
+
+
+def test_request_wire_roundtrip():
+    req = Request(rid="r7", prompt=np.arange(5, dtype=np.int32),
+                  max_new_tokens=6, temperature=0.6, top_k=3, seed=12,
+                  eos_id=2, arrival=1.25)
+    back = request_from_wire(request_to_wire(req))
+    assert back.rid == req.rid and back.seed == req.seed
+    assert back.temperature == req.temperature
+    assert back.top_k == req.top_k and back.eos_id == req.eos_id
+    np.testing.assert_array_equal(np.asarray(back.prompt),
+                                  np.asarray(req.prompt))
+
+
+# ---- real-process sessions (slow) -----------------------------------------
+
+ECFG = EngineConfig(capacity=2, block_size=4, blocks_per_slot=8,
+                    prefill_chunk=4)
+
+
+@pytest.fixture(scope="module")
+def setup(tiny_llama_f32):
+    cfg, model, params, _ = tiny_llama_f32
+    prompts = [
+        np.array(jax.random.randint(
+            jax.random.key(80 + i), (1, 3 + (i % 4)), 0,
+            cfg.vocab_size), dtype=np.int32)
+        for i in range(6)
+    ]
+    return cfg, model, params, prompts
+
+
+def _requests(prompts, max_new=8):
+    return [Request(rid=f"r{i}", prompt=p[0], max_new_tokens=max_new,
+                    temperature=0.6 if i % 2 else 0.0,
+                    top_k=3 if i % 2 else None, seed=9 + i)
+            for i, p in enumerate(prompts)]
+
+
+def _refs(model, params, prompts, reqs):
+    return {r.rid: np.asarray(generate(
+        model, params, prompts[i], r.max_new_tokens,
+        temperature=r.temperature, top_k=r.top_k, seed=r.seed))[0]
+        for i, r in enumerate(reqs)}
+
+
+def _session_cfg(tmp_path, **over):
+    kw = dict(n_replicas=1, backend="process", engine=ECFG,
+              run_dir=str(tmp_path / "run"),
+              compile_cache_dir=str(tmp_path / "cc"),
+              platform="cpu", cpu_devices_per_rank=1,
+              env={"JAX_PLATFORMS": "cpu"}, max_restarts=2,
+              metrics_flush_every_n_ticks=2)
+    kw.update(over)
+    return ReplicaGroupConfig(**kw)
+
+
+def _drive(drv, reqs):
+    for req in reqs:
+        drv.submit(req)
+    while drv.busy():
+        drv.tick()
+        time.sleep(0.01)
+    return drv.stop()
+
+
+@pytest.mark.slow
+def test_session_tp2_streams_bitwise_and_compiles_once(setup, tmp_path):
+    """A 2-process TP=2 replica (one WorkerGroup over its own tensor
+    mesh, scheduler in lockstep off the request channel) streams every
+    request token-for-token bitwise against single-process `generate()`
+    — and the whole churn compiles the SPMD step exactly once."""
+    cfg, model, params, prompts = setup
+    reqs = _requests(prompts)
+    refs = _refs(model, params, prompts, reqs)
+    pp = str(tmp_path / "params.npz")
+    save_params_npz(params, pp)
+    drv = ServeDriver(cfg, pp, _session_cfg(tmp_path, tp=2))
+    drv.start()
+    res = _drive(drv, reqs)
+    for rid, ref in refs.items():
+        np.testing.assert_array_equal(np.array(res.outputs[rid]), ref,
+                                      err_msg=rid)
+    assert res.stats["compile_count"] == 1
+    assert res.restarts == {0: 0}
+    assert len(res.meta) == len(reqs)
+
+
+@pytest.mark.slow
+def test_session_kill_respawns_whole_group_and_replays(setup, tmp_path):
+    """Mid-stream leader SIGKILL on a TP=2 replica: the death classifies
+    retryable (resilience.policy), the WHOLE worker group respawns on a
+    fresh channel epoch, the epoch replay re-serves the unfinished
+    assignment, and every stream still matches `generate()` bitwise."""
+    cfg, model, params, prompts = setup
+    reqs = _requests(prompts)
+    refs = _refs(model, params, prompts, reqs)
+    pp = str(tmp_path / "params.npz")
+    save_params_npz(params, pp)
+    drv = ServeDriver(cfg, pp, _session_cfg(tmp_path, tp=2))
+    drv.start(fault={"replica": 0, "kill_after_tokens": 10})
+    res = _drive(drv, reqs)
+    assert res.restarts[0] >= 1, "kill did not trigger a respawn"
+    for rid, ref in refs.items():
+        np.testing.assert_array_equal(np.array(res.outputs[rid]), ref,
+                                      err_msg=rid)
+    # the respawn rolled the command log to a fresh epoch
+    session_dir = str(tmp_path / "run")
+    epochs = sorted(p.name for p in channel_dir(session_dir, 0).iterdir())
+    assert "epoch1.jsonl" in epochs
