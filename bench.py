@@ -566,7 +566,17 @@ def _serve_summary() -> dict:
     ``serve_decode_ici_bytes_per_tick`` (top-level, EVERY line) is
     that schedule's total wire bytes per decode tick; bench_gate
     CEILING-ratchets it (decode collectives ride the latency-critical
-    path, so their per-tick traffic may only shrink)."""
+    path, so their per-tick traffic may only shrink).
+
+    ``prefix_plan`` / ``speculative_plan`` (ISSUE 19, inside
+    ``serving`` — EVERY line) statically price the scheduler's two
+    decode accelerators at the flagship shape: the pool bytes + prefill
+    tokens a shared prefix saves across the fleet, and the verify-step
+    FLOPs vs k plain decode ticks with the expected tokens/tick. The
+    MEASURED twins — ``shared_block_fraction`` and
+    ``accepted_tokens_per_step`` from the steady-state leg — ride
+    success lines and bench_gate RATCHETS both (higher is better;
+    waived on skip)."""
     try:
         import jax.numpy as jnp
 
@@ -597,14 +607,33 @@ def _serve_summary() -> dict:
                  "wire_bytes": e.wire_bytes, "source": e.source}
                 for e in report_tp.collectives],
         }
+        # static pricing for the scheduler's two decode accelerators
+        # (ISSUE 19): prefix sharing across a full fleet of slots and
+        # speculative decoding vs a quarter-depth draft — byte/FLOP
+        # math from serve/audit.py, carried on EVERY line like the
+        # rest of the serve plan
+        import dataclasses as _dc
+
+        from ray_lightning_tpu.serve.audit import (
+            shared_prefix_plan, speculative_plan,
+        )
+
+        draft_cfg = _dc.replace(cfg, n_layers=max(1, cfg.n_layers // 4))
+        prefix_plan = shared_prefix_plan(cfg, ecfg,
+                                         n_streams=ecfg.capacity)
+        spec_plan = speculative_plan(cfg, draft_cfg, ecfg)
         return {"serve_tp": serve_tp,
                 "serve_decode_ici_bytes_per_tick": ici_tick,
                 "serving": {
             "schema": ["decode_tokens_per_s", "prefill_tokens_per_s",
                        "ttft_cold_s", "ttft_warm_s", "ttft_p99_s",
-                       "slot_occupancy", "serving_attention_path",
+                       "slot_occupancy", "shared_block_fraction",
+                       "accepted_tokens_per_step",
+                       "serving_attention_path",
                        "serving_prefill_path", "serve_metrics",
                        "scale_up_s", "autoscale"],
+            "prefix_plan": prefix_plan,
+            "speculative_plan": spec_plan,
             "autoscale_schema": {
                 "scale_up_s": "wall seconds one controller-driven "
                               "add_replica pays (spawn + weights + "
@@ -691,9 +720,12 @@ def _measure_serving(tiny: bool | None = None,
     ttft_cold = first_token_wall(engine)
     # TTFT warm: the same compiled engine, a fresh request
     ttft_warm = first_token_wall(engine, metrics=reg)
-    # steady-state decode throughput, slots saturated
+    # steady-state decode throughput, slots saturated. The requests
+    # share ONE prompt, so the prefix cache measures its real effect:
+    # the common blocks prefill once and map into every slot's table
+    # (shared_block_fraction below; decode streams stay bitwise)
     engine.metrics = reg
-    sched = Scheduler(engine, metrics=reg)
+    sched = Scheduler(engine, metrics=reg, prefix_cache=True)
     for i in range(n_requests):
         sched.submit(Request(rid=f"r{i}", prompt=prompt[0],
                              max_new_tokens=max_new, seed=i))
@@ -740,6 +772,14 @@ def _measure_serving(tiny: bool | None = None,
         "ttft_warm_s": round(ttft_warm, 4),
         "ttft_p99_s": round(ttft_p99, 4) if ttft_p99 else None,
         "slot_occupancy": round(sched.slot_occupancy, 4),
+        # measured prefix-sharing / speculative twins of the static
+        # plans (ISSUE 19): fraction of mapped blocks that were shared
+        # in the steady-state leg, and tokens emitted per decoding
+        # slot-step (exactly 1.0 without a draft — the spec ratchet's
+        # honest baseline)
+        "shared_block_fraction": round(sched.shared_block_fraction, 4),
+        "accepted_tokens_per_step": round(
+            sched.accepted_tokens_per_step, 4),
         "serving_compile_count": engine.compile_count,
         # which attention each lane actually exercised — a
         # decode/prefill tok/s number is only comparable to priors on

@@ -270,6 +270,8 @@ def _run_serve_plan(args) -> int:
     (docs/SERVING.md "paged-attention kernel"); the decode-step trace
     audits the SAME path. Same exit contract as the training plan: 0
     fits, 1 does not, 2 invalid."""
+    import dataclasses
+
     import jax.numpy as jnp
 
     from ray_lightning_tpu.models.llama import LlamaConfig
@@ -277,6 +279,8 @@ def _run_serve_plan(args) -> int:
         audit_decode_step,
         format_serve_summary,
         serve_memory_summary,
+        shared_prefix_plan,
+        speculative_plan,
     )
     from ray_lightning_tpu.serve.engine import EngineConfig
 
@@ -300,6 +304,14 @@ def _run_serve_plan(args) -> int:
         summary = serve_memory_summary(
             cfg, ecfg, device_kind=args.device_kind,
             hbm_bytes=args.hbm_bytes, tp=args.tp)
+        # static pricing for the scheduler's two decode accelerators:
+        # prefix sharing across a full fleet of slots, and speculative
+        # decoding against a quarter-depth draft at the default k
+        draft_cfg = dataclasses.replace(
+            cfg, n_layers=max(1, cfg.n_layers // 4))
+        prefix = shared_prefix_plan(cfg, ecfg,
+                                    n_streams=args.serve_slots)
+        spec = speculative_plan(cfg, draft_cfg, ecfg)
     except ValueError as exc:
         return _plan_invalid(str(exc), args.as_json)
     trace = None
@@ -342,12 +354,27 @@ def _run_serve_plan(args) -> int:
             trace = {"trace_error":
                      f"{type(exc).__name__}: {str(exc)[:300]}"}
     if args.as_json:
-        out = {"serve": summary, "fits": summary["fits"]}
+        out = {"serve": summary, "fits": summary["fits"],
+               "prefix_sharing": prefix, "speculative": spec}
         if trace is not None:
             out["trace"] = trace
         print(json.dumps(out))
     else:
         print(format_serve_summary(summary))
+        mib = 1024.0**2
+        print(f"prefix sharing ({prefix['n_streams']} streams, "
+              f"{prefix['prefix_tokens']}-token prefix): pool bytes "
+              f"saved {prefix['shared_pool_bytes_saved'] / mib:.1f} "
+              f"MiB; prefill tokens saved "
+              f"{prefix['prefill_tokens_saved']}")
+        print(f"speculative (k={spec['k']}, accept "
+              f"{spec['accept_rate']:.2f}): verify step "
+              f"{spec['verify_step_flops'] / 1e9:.2f} GFLOP vs "
+              f"{spec['k']} base ticks "
+              f"{spec['k'] * spec['base_decode_flops_per_token'] / 1e9:.2f}"
+              f" GFLOP; expected tokens/tick "
+              f"{spec['expected_tokens_per_tick']:.2f}; memory-bound "
+              f"speedup {spec['memory_bound_speedup_x']:.2f}x")
         if trace is not None:
             if "trace_error" in trace:
                 print(f"tracecheck: unavailable ({trace['trace_error']})")

@@ -141,6 +141,17 @@ RULES: Dict[str, Rule] = {r.id: r for r in (
          "(ops/pallas/paged_prefill.py; selected automatically on TPU "
          "— docs/SERVING.md 'paged prefill kernel'). Shapes the "
          "kernel cannot tile keep the historical sanction"),
+    Rule("RLT309", "redundant-prefix-prefill", "warning",
+         "a serve-side loop submits one request per iteration whose "
+         "prompt prepends a LOOP-INVARIANT prefix (a shared system "
+         "prompt) without prefix_cache=True anywhere in the file: "
+         "every request re-prefills the identical prefix tokens and "
+         "pins its own pool copy of those blocks, so prefill compute "
+         "and KV HBM both scale with the stream count instead of "
+         "once. Arm the scheduler's prefix cache — the common prefix "
+         "prefills ONCE and its full blocks map into every table by "
+         "refcount, copy-on-write on divergence (serve/kv_cache.py "
+         "PrefixCache, docs/SERVING.md 'prefix cache')"),
     Rule("RLT303", "ring-deadlock", "error",
          "a ppermute permutation is not a valid schedule (duplicate "
          "source/destination, out-of-range rank, a full permutation "

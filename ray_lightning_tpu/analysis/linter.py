@@ -1610,6 +1610,143 @@ class _ChannelChatterLint:
         walk(tree.body, None)
 
 
+# ---- RLT309: redundant prefix prefill -------------------------------------
+
+#: serving submission verbs — one request enqueued per call
+_RLT309_SUBMIT_VERBS = {"submit", "enqueue"}
+#: prompt-concatenation spellings (np/jnp.concatenate + friends)
+_RLT309_CONCAT = {"concatenate", "concat", "hstack"}
+
+
+class _PrefixPrefillLint:
+    """RLT309 redundant-prefix-prefill (docs/SERVING.md "prefix
+    cache"): a serve-side loop submitting one request per iteration
+    whose prompt PREPENDS a loop-invariant prefix — the shared system
+    prompt — while the file never arms ``prefix_cache=True``. Every
+    request then re-prefills the identical prefix tokens and holds its
+    own pool copy of them; the scheduler's prefix cache prefills the
+    common prefix ONCE and maps the full blocks into each table at
+    refcount (`serve/kv_cache.py PrefixCache`, copy-on-write on
+    divergence). Any ``prefix_cache=True`` keyword in the file
+    sanctions it — the cache is armed, the loop is the intended
+    usage."""
+
+    def __init__(self, lint: _FileLint):
+        self.lint = lint
+
+    @staticmethod
+    def _armed(tree: ast.Module) -> bool:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            for kw in node.keywords:
+                if (kw.arg == "prefix_cache"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value is True):
+                    return True
+        return False
+
+    @staticmethod
+    def _const_prefix(expr: ast.AST,
+                      variant: Set[str]) -> Optional[str]:
+        """The loop-invariant Name a prompt expression PREPENDS, or
+        None. Covers ``np.concatenate([sys, tail])`` (list/tuple or
+        vararg form) and ``sys + tail``."""
+        if isinstance(expr, ast.Call):
+            fname = (_dotted(expr.func) or "").split(".")[-1]
+            if fname in _RLT309_CONCAT and expr.args:
+                seq = expr.args[0]
+                first = (seq.elts[0]
+                         if isinstance(seq, (ast.List, ast.Tuple))
+                         and seq.elts else seq)
+                if (isinstance(first, ast.Name)
+                        and first.id not in variant):
+                    return first.id
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+            if (isinstance(expr.left, ast.Name)
+                    and expr.left.id not in variant):
+                return expr.left.id
+        return None
+
+    def _lint_loop(self, loop: ast.For,
+                   symbol: Optional[str]) -> None:
+        variant: Set[str] = {
+            n.id for n in ast.walk(loop.target)
+            if isinstance(n, ast.Name)}
+        assigns: Dict[str, ast.AST] = {}
+        nodes = list(_rlt503_loop_nodes(loop))
+        for node in nodes:
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    for n in ast.walk(tgt):
+                        if isinstance(n, ast.Name):
+                            variant.add(n.id)
+                if (len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)):
+                    assigns[node.targets[0].id] = node.value
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                for n in ast.walk(node.target):
+                    if isinstance(n, ast.Name):
+                        variant.add(n.id)
+        for node in nodes:
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _RLT309_SUBMIT_VERBS):
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                for kw in sub.keywords:
+                    if kw.arg != "prompt":
+                        continue
+                    expr = kw.value
+                    if (isinstance(expr, ast.Name)
+                            and expr.id in assigns):
+                        expr = assigns[expr.id]
+                    prefix = self._const_prefix(expr, variant)
+                    if prefix is None:
+                        continue
+                    recv = (_dotted(node.func.value)
+                            or "").split(".")[-1]
+                    self.lint.add(
+                        "RLT309",
+                        f"{recv}.{node.func.attr}() re-submits the "
+                        f"loop-invariant prefix {prefix!r} on every "
+                        "request's prompt without prefix_cache=True: "
+                        "each request PREFILLS the identical prefix "
+                        "again and pins its own pool copy of those "
+                        "blocks. Arm the scheduler's prefix cache "
+                        "(Scheduler(engine, prefix_cache=True)) — the "
+                        "common prefix prefills ONCE and the full "
+                        "blocks map into every table by refcount, "
+                        "copy-on-write on divergence (serve/"
+                        "kv_cache.py, docs/SERVING.md 'prefix cache')",
+                        node, symbol)
+                    return
+
+    def run(self, tree: ast.Module, funcs: List["_Func"]) -> None:
+        if self._armed(tree):
+            return
+        traced_nodes = {id(fn.node) for fn in funcs if fn.traced}
+
+        def walk(stmts, symbol):
+            for node in stmts:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    # traced code has no scheduler to submit to —
+                    # same scope rule as the other serve-loop lints
+                    if id(node) not in traced_nodes:
+                        walk(node.body, node.name)
+                    continue
+                if isinstance(node, ast.Lambda):
+                    continue
+                if isinstance(node, ast.For):
+                    self._lint_loop(node, symbol)
+                walk(list(ast.iter_child_nodes(node)), symbol)
+
+        walk(tree.body, None)
+
+
 def lint_source(source: str, filename: str = "<string>",
                 extra_axes: Sequence[str] = ()) -> List[Finding]:
     """Lint one file's source text. Never imports the target."""
@@ -1672,6 +1809,7 @@ def lint_source(source: str, filename: str = "<string>",
     _PinnedWorldLint(lint).run(tree)
     _LedgerTailLint(lint).run(tree, coll)
     _ChannelChatterLint(lint).run(tree, coll.funcs)
+    _PrefixPrefillLint(lint).run(tree, coll.funcs)
     return lint.findings
 
 

@@ -647,6 +647,114 @@ def serve_memory_summary(model_cfg, engine_cfg: EngineConfig,
     }
 
 
+def _param_count(model_cfg) -> int:
+    """Parameter count by eval_shape — no device, no init."""
+    import jax
+    import numpy as np
+
+    from ray_lightning_tpu.models.llama import Llama
+
+    model = Llama(model_cfg)
+    a_params = jax.eval_shape(
+        lambda key: model.init(key, np.zeros((1, 2), np.int32))["params"],
+        jax.eval_shape(lambda: jax.random.key(0)))
+    return sum(int(np.prod(leaf.shape or (1,)))
+               for leaf in jax.tree.leaves(a_params))
+
+
+def speculative_plan(model_cfg, draft_cfg, engine_cfg: EngineConfig,
+                     accept_rate: float = 0.6) -> dict:
+    """Price speculative decoding at this (target, draft, engine)
+    shape — pure byte/FLOP math, no devices (the ``plan --serve`` and
+    bench static-pricing leg).
+
+    The cost model: one speculative tick spends ONE k-wide verify pass
+    of the target (k token-forwards of compute, but a SINGLE sweep of
+    the weights + pool — the memory-bound decode's actual currency)
+    plus ``k`` single-token draft trips, and emits ``1 +
+    accept_rate * (k - 1)`` tokens in expectation. Against ``k`` plain
+    decode ticks (k weight+pool sweeps for k tokens), the win is the
+    HBM-traffic ratio ``memory_bound_speedup_x``; the FLOP overhead
+    ``flops_overhead_x`` is the price (verify recomputes every
+    proposal, and rejected tails are discarded work)."""
+    import numpy as np
+
+    from ray_lightning_tpu.serve.kv_cache import pool_bytes
+
+    k = engine_cfg.draft.k if engine_cfg.draft is not None else 4
+    if not 0.0 <= accept_rate <= 1.0:
+        raise ValueError(f"accept_rate {accept_rate} not in [0, 1]")
+    n_t, n_d = _param_count(model_cfg), _param_count(draft_cfg)
+    spec = engine_cfg.pool_spec
+    flops_per_token = 2 * n_t                 # one target token-forward
+    verify_step_flops = k * flops_per_token   # one k-wide chunk
+    draft_flops_per_tick = k * 2 * n_d        # k single-token trips
+    expected = 1.0 + accept_rate * (k - 1)
+    params_bytes = n_t * np.dtype(model_cfg.dtype).itemsize
+    draft_params_bytes = n_d * np.dtype(draft_cfg.dtype).itemsize
+    pool = pool_bytes(model_cfg, spec)
+    draft_pool = pool_bytes(draft_cfg, spec)
+    # HBM read traffic per tick: the base tick sweeps target weights +
+    # pool once per token; the spec tick sweeps them once per k-token
+    # verify, plus k draft sweeps
+    base_reads = params_bytes + pool
+    spec_reads = base_reads + k * (draft_params_bytes + draft_pool)
+    return {
+        "k": k,
+        "accept_rate": accept_rate,
+        "target_params": n_t,
+        "draft_params": n_d,
+        "draft_params_bytes": int(draft_params_bytes),
+        "draft_pool_bytes": int(draft_pool),
+        "verify_step_flops": int(verify_step_flops),
+        "draft_flops_per_tick": int(draft_flops_per_tick),
+        "base_decode_flops_per_token": int(flops_per_token),
+        "expected_tokens_per_tick": expected,
+        "flops_per_emitted_token": int(
+            (verify_step_flops + draft_flops_per_tick) / expected),
+        "flops_overhead_x": (verify_step_flops + draft_flops_per_tick)
+        / (expected * flops_per_token),
+        "hbm_read_bytes_per_tick_base": int(base_reads),
+        "hbm_read_bytes_per_tick_spec": int(spec_reads),
+        "memory_bound_speedup_x": expected * base_reads / spec_reads,
+    }
+
+
+def shared_prefix_plan(model_cfg, engine_cfg: EngineConfig,
+                       n_streams: int = 8,
+                       prefix_tokens: Optional[int] = None) -> dict:
+    """Price prefix sharing for ``n_streams`` requests over a common
+    ``prefix_tokens``-token prompt prefix (default: half the slot).
+    Only FULL blocks share (K/V at a position depends on the whole
+    prefix, so the chain caches per complete block); the savings are
+    the pool bytes and prefill tokens the other ``n_streams - 1``
+    requests never spend — the ``plan --serve`` / bench static-pricing
+    twin of the scheduler's measured `shared_block_fraction`."""
+    import numpy as np
+
+    spec = engine_cfg.pool_spec
+    P = spec.block_size
+    if prefix_tokens is None:
+        prefix_tokens = engine_cfg.max_slot_len // 2
+    if n_streams < 1:
+        raise ValueError(f"n_streams {n_streams} < 1")
+    full = min(prefix_tokens, engine_cfg.max_slot_len) // P
+    block_bytes = (2 * model_cfg.n_layers * P * model_cfg.n_kv_heads
+                   * model_cfg.head_dim
+                   * np.dtype(model_cfg.dtype).itemsize)
+    return {
+        "n_streams": n_streams,
+        "prefix_tokens": int(prefix_tokens),
+        "shared_full_blocks": int(full),
+        "block_bytes": int(block_bytes),
+        "pool_bytes_without_sharing": int(n_streams * full * block_bytes),
+        "pool_bytes_with_sharing": int(full * block_bytes),
+        "shared_pool_bytes_saved": int(
+            (n_streams - 1) * full * block_bytes),
+        "prefill_tokens_saved": int((n_streams - 1) * full * P),
+    }
+
+
 def format_serve_summary(s: dict) -> str:
     gib = 1024**3
     fused = s.get("attention_path") == "paged-pallas"

@@ -41,7 +41,15 @@ runs; it fails (exit 1) unless ALL of:
     token-for-token equal to the reference-lane engine, churn compiles
     once, and the fused step audits clean with ZERO dense paged
     gathers at ANY nesting level (RLT307 + RLT308 absent, the
-    paged-prefill kernel present in the trace).
+    paged-prefill kernel present in the trace);
+  * the PREFIX-SHARING leg (docs/SERVING.md "prefix cache"): an
+    8-stream fleet behind one common system prompt decodes bitwise vs
+    per-stream `generate()` with ``shared_block_fraction > 0`` AND a
+    prefill-token count STRICTLY below the same fleet served without
+    the cache — the shared prefix prefilled exactly once;
+  * the SPECULATIVE leg (docs/SERVING.md "speculative decoding"):
+    draft+target greedy decode is TOKEN-IDENTICAL to plain greedy
+    `generate()`, still at compile-count 1.
 """
 from __future__ import annotations
 
@@ -249,6 +257,12 @@ def run_smoke(args) -> int:
     # ---- leg 5: fused paged-PREFILL path ------------------------------
     verdict["legs"]["fused_prefill"] = _smoke_fused_prefill_leg(
         failures, args.topo)
+
+    # ---- leg 6: prefix sharing — the common prefix prefills ONCE ------
+    verdict["legs"]["prefix_sharing"] = _smoke_prefix_leg(failures)
+
+    # ---- leg 7: speculative decode — greedy token identity ------------
+    verdict["legs"]["speculative"] = _smoke_spec_leg(failures)
 
     verdict["ok"] = not failures
     if failures:
@@ -577,6 +591,141 @@ def _smoke_fused_prefill_leg(failures: list, topo: str) -> dict:
         failures.append("the paged-prefill kernel is absent from the "
                         "fused trace — the prefill lane fell back to "
                         "the gathering reference op")
+    return leg
+
+
+def _smoke_prefix_leg(failures: list) -> dict:
+    """The prefix-sharing smoke leg: an 8-stream fleet behind ONE
+    common system prompt decodes bitwise vs per-stream `generate()`,
+    with the shared prefix prefilled exactly once — the cached run's
+    prefill-token count must be STRICTLY below the same fleet served
+    without the cache, and ``shared_block_fraction`` must be > 0."""
+    import jax
+    import numpy as np
+
+    from ray_lightning_tpu.models.llama import generate
+    from ray_lightning_tpu.serve.engine import DecodeEngine, EngineConfig
+    from ray_lightning_tpu.serve.scheduler import Request, Scheduler
+
+    cfg, model, params, _, _ = _tiny_setup(1, 1)
+    sys_prompt = np.asarray(jax.random.randint(
+        jax.random.key(7), (9,), 0, cfg.vocab_size), np.int32)
+    prompts = []
+    for i in range(8):
+        tail = np.asarray(jax.random.randint(
+            jax.random.key(200 + i), (2 + i % 3,), 0, cfg.vocab_size),
+            np.int32)
+        prompts.append(np.concatenate([sys_prompt, tail]))
+    ecfg = EngineConfig(capacity=4, block_size=4, blocks_per_slot=8,
+                        prefill_chunk=4)
+
+    def fleet(prefix_cache: bool):
+        eng = DecodeEngine(model, params, ecfg)
+        eng.warmup()
+        sched = Scheduler(eng, prefix_cache=prefix_cache)
+        for i, p in enumerate(prompts):
+            sched.submit(Request(rid=f"p{i}", prompt=p,
+                                 max_new_tokens=6, seed=41 + i))
+        outputs = {}
+        while sched.busy():
+            for comp in sched.tick():
+                outputs[comp.rid] = list(comp.tokens)
+        return outputs, sched, eng
+
+    outputs, sched, eng = fleet(prefix_cache=True)
+    _, sched_cold, _ = fleet(prefix_cache=False)
+    bad = []
+    for i, p in enumerate(prompts):
+        ref = np.asarray(generate(model, params, p[None], 6,
+                                  temperature=0.0, seed=41 + i))[0]
+        if not np.array_equal(ref, np.asarray(outputs.get(f"p{i}", []))):
+            bad.append(f"p{i}")
+    leg = {
+        "bitwise_mismatches": bad,
+        "shared_block_fraction": round(sched.shared_block_fraction, 4),
+        "prefill_tokens_issued": sched.prefill_tokens_issued,
+        "prefill_tokens_no_sharing": sched_cold.prefill_tokens_issued,
+        "compile_count": eng.compile_count,
+    }
+    if bad:
+        failures.append(
+            f"prefix-shared streams diverge from generate(): {bad}")
+    if sched.shared_block_fraction <= 0.0:
+        failures.append(
+            "the common system prompt produced no shared blocks "
+            f"(shared_block_fraction="
+            f"{sched.shared_block_fraction})")
+    if not (sched.prefill_tokens_issued
+            < sched_cold.prefill_tokens_issued):
+        failures.append(
+            f"prefix cache did not reduce prefill work: "
+            f"{sched.prefill_tokens_issued} issued vs "
+            f"{sched_cold.prefill_tokens_issued} without sharing")
+    if eng.compile_count not in (1, -1):
+        failures.append(
+            f"prefix-shared churn recompiled the step: compile_count="
+            f"{eng.compile_count} (want 1)")
+    return leg
+
+
+def _smoke_spec_leg(failures: list) -> dict:
+    """The speculative smoke leg: draft+target greedy decode must be
+    TOKEN-IDENTICAL to plain greedy `generate()` — the accept/reject
+    rule is exact, never approximate — still at compile-count 1."""
+    import jax
+    import numpy as np
+
+    from ray_lightning_tpu.models.llama import Llama, generate
+    from ray_lightning_tpu.serve.engine import (
+        DecodeEngine, DraftConfig, EngineConfig,
+    )
+    from ray_lightning_tpu.serve.scheduler import Request, Scheduler
+
+    cfg, model, params, prompts, _ = _tiny_setup(6, 6)
+    # an INDEPENDENT draft (same architecture, different weights) —
+    # acceptance is partial, so the rejection path runs for real
+    draft = Llama(cfg)
+    draft_params = jax.jit(draft.init)(jax.random.key(97),
+                                       prompts[0])["params"]
+    ecfg = EngineConfig(capacity=4, block_size=4, blocks_per_slot=8,
+                        prefill_chunk=4, draft=DraftConfig(k=3))
+    eng = DecodeEngine(model, params, ecfg, draft_model=draft,
+                       draft_params=draft_params)
+    eng.warmup()
+    sched = Scheduler(eng)
+    for i, p in enumerate(prompts):
+        sched.submit(Request(rid=f"s{i}", prompt=p[0],
+                             max_new_tokens=6, seed=61 + i))
+    outputs = {}
+    while sched.busy():
+        for comp in sched.tick():
+            outputs[comp.rid] = list(comp.tokens)
+    bad = []
+    for i, p in enumerate(prompts):
+        ref = np.asarray(generate(model, params, p, 6,
+                                  temperature=0.0, seed=61 + i))[0]
+        if not np.array_equal(ref, np.asarray(outputs.get(f"s{i}", []))):
+            bad.append(f"s{i}")
+    leg = {
+        "bitwise_mismatches": bad,
+        "k": ecfg.draft.k,
+        "accepted_tokens_per_step": round(
+            sched.accepted_tokens_per_step, 4),
+        "compile_count": eng.compile_count,
+    }
+    if bad:
+        failures.append(
+            f"speculative greedy decode diverges from plain greedy: "
+            f"{bad}")
+    if sched.accepted_tokens_per_step < 1.0:
+        failures.append(
+            f"speculative decode emitted fewer than one token per "
+            f"slot-step ({sched.accepted_tokens_per_step}) — the "
+            "bonus-token accounting is broken")
+    if eng.compile_count not in (1, -1):
+        failures.append(
+            f"speculative churn recompiled the step: compile_count="
+            f"{eng.compile_count} (want 1)")
     return leg
 
 

@@ -148,10 +148,26 @@ class ReplicaGroupConfig:
     flight_ring: int = 256
     #: flight ring persist cadence in recorded events
     flight_persist_every: int = 16
+    #: draft model config (models.llama.LlamaConfig) for speculative
+    #: decoding — arms together with ``engine.draft``; inline replicas
+    #: only (the process respawn path reloads ONE params .npz and the
+    #: wire carries no draft weights)
+    draft_model_cfg: Optional[Any] = None
 
     def __post_init__(self):
         if self.backend not in ("inline", "process"):
             raise ValueError(f"backend={self.backend!r}")
+        if self.backend == "process" and (
+                self.engine.draft is not None
+                or self.draft_model_cfg is not None):
+            raise ValueError(
+                "speculative decoding is inline-only: a process "
+                "replica (re)spawns from the params .npz, which "
+                "carries no draft weights")
+        if (self.engine.draft is None) != (self.draft_model_cfg is None):
+            raise ValueError(
+                "engine.draft and draft_model_cfg arm together — set "
+                "both (speculative) or neither")
         if self.n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
         if self.tp < 1:
@@ -736,7 +752,8 @@ class ServeDriver:
     replica.
     """
 
-    def __init__(self, model_cfg, params, cfg: ReplicaGroupConfig):
+    def __init__(self, model_cfg, params, cfg: ReplicaGroupConfig,
+                 draft_params=None):
         self.model_cfg = model_cfg
         self.cfg = cfg
         self.params = params
@@ -745,6 +762,11 @@ class ServeDriver:
             raise ValueError(
                 "process replicas need a params .npz path "
                 "(save_params_npz) — the respawn path reloads from it")
+        if (cfg.draft_model_cfg is not None) != (draft_params is not None):
+            raise ValueError(
+                "cfg.draft_model_cfg and draft_params arm together — "
+                "pass both (speculative inline replicas) or neither")
+        self.draft_params = draft_params
         # ---- dynamic serving session state (docs/AUTOSCALE.md) ----
         self._session_active = False
         self.replicas: Dict[int, "_Replica"] = {}
@@ -777,6 +799,8 @@ class ServeDriver:
         if self.params_path is not None:
             params = load_params_npz(self.params_path)
         model = Llama(self.model_cfg)
+        draft_model = (Llama(self.cfg.draft_model_cfg)
+                       if self.cfg.draft_model_cfg is not None else None)
         outputs: Dict[str, List[int]] = {}
         meta: Dict[str, dict] = {}
         stats_occ: List[float] = []
@@ -794,7 +818,9 @@ class ServeDriver:
                 maxlen=mc["flight_ring"],
                 persist_every=mc["flight_persist_every"])
             engine = DecodeEngine(model, params, self.cfg.engine,
-                                  metrics=metrics)
+                                  metrics=metrics,
+                                  draft_model=draft_model,
+                                  draft_params=self.draft_params)
             engine.warmup()
             sched = Scheduler(engine, reserve=self.cfg.reserve,
                               metrics=metrics, flight=flight)
@@ -1087,6 +1113,9 @@ class ServeDriver:
             from ray_lightning_tpu.models.llama import Llama
 
             self._model = Llama(self.model_cfg)
+            self._draft_model = (
+                Llama(self.cfg.draft_model_cfg)
+                if self.cfg.draft_model_cfg is not None else None)
         else:
             self._session_dir = self.cfg.run_dir or os.path.join(
                 os.getcwd(), "rlt_logs", "serve")
@@ -1176,7 +1205,9 @@ class ServeDriver:
                               maxlen=mc["flight_ring"],
                               persist_every=mc["flight_persist_every"])
         engine = DecodeEngine(self._model, params, self.cfg.engine,
-                              metrics=metrics)
+                              metrics=metrics,
+                              draft_model=self._draft_model,
+                              draft_params=self.draft_params)
         engine.warmup()
         sched = Scheduler(engine, reserve=self.cfg.reserve,
                           metrics=metrics, flight=flight)

@@ -61,6 +61,24 @@ from ray_lightning_tpu.serve.kv_cache import (
 
 
 @dataclasses.dataclass(frozen=True)
+class DraftConfig:
+    """Speculative-decoding knob (docs/SERVING.md "speculative
+    decoding"): a small DRAFT model proposes ``k - 1`` greedy tokens
+    per tick and the target verifies all ``k`` (the carried token plus
+    the proposals) in ONE k-wide chunk riding the same multi-token
+    machinery as chunked prefill. Greedy accept/reject keeps the
+    emitted stream token-identical to plain greedy decode; ``k = 1``
+    degenerates to the base engine (no proposals, one verify row)."""
+
+    #: tokens verified per tick (1 carried + k-1 draft proposals)
+    k: int = 4
+
+    def __post_init__(self):
+        if self.k < 1:
+            raise ValueError(f"draft k must be >= 1, got {self.k}")
+
+
+@dataclasses.dataclass(frozen=True)
 class EngineConfig:
     """Static shape of one serving replica's compiled step."""
 
@@ -85,8 +103,18 @@ class EngineConfig:
     #: of attention forever. 1 (default) lowers the identical
     #: historical single-slot program — no pad inputs anywhere.
     prefill_batch: int = 1
+    #: speculative decoding (None = the base single-token step). Set,
+    #: the engine requires a draft model/params at construction, runs
+    #: `build_spec_step`'s k-token verify tick, and the scheduler
+    #: enforces greedy-only sampling plus the k-1 slot-overflow
+    #: headroom in `validate_request`.
+    draft: Optional[DraftConfig] = None
 
     def __post_init__(self):
+        if isinstance(self.draft, dict):
+            # survive the dataclasses.asdict round trip the process
+            # replica backend ships configs through
+            object.__setattr__(self, "draft", DraftConfig(**self.draft))
         if self.capacity < 1:
             raise ValueError("capacity must be >= 1")
         if self.prefill_chunk < 1:
@@ -102,6 +130,16 @@ class EngineConfig:
             raise ValueError(
                 f"prefill_chunk {self.prefill_chunk} exceeds "
                 f"max_slot_len "
+                f"{self.blocks_per_slot * self.block_size}")
+        if self.draft is not None and self.prefill_batch != 1:
+            raise ValueError(
+                "speculative decoding (draft=...) requires "
+                "prefill_batch == 1 — the verify chunk rides the "
+                "single-slot program")
+        if self.draft is not None and \
+                self.draft.k > self.blocks_per_slot * self.block_size:
+            raise ValueError(
+                f"draft k {self.draft.k} exceeds max_slot_len "
                 f"{self.blocks_per_slot * self.block_size}")
 
     @property
@@ -457,6 +495,193 @@ def build_step(model, cfg: EngineConfig, fused: bool = False,
     return step
 
 
+def build_spec_step(model, draft_model, cfg: EngineConfig):
+    """The speculative-decoding twin of `build_step` (single-slot
+    prefill lane only; reference attention lanes only — the verify
+    chunk and the draft's gathered view are priced honestly by
+    `serve.audit.speculative_plan`).
+
+    Per tick, per decoding slot, with ``k = cfg.draft.k``:
+
+      1. ``t0 = sample(last_logits)`` — the SAME `_sample` trip as the
+         base step (greedy when temp == 0; the scheduler enforces
+         greedy-only for draft-armed engines).
+      2. The DRAFT model runs ``k`` single-token feedback steps over
+         its own paged pool (same block tables), feeding
+         ``[t0, d1..d_{k-1}]`` and writing draft K/V at positions
+         ``pos..pos+k-1`` — so at full acceptance the draft cache is
+         complete through the last accepted position. The k-th greedy
+         proposal is discarded.
+      3. The TARGET verifies the whole chunk ``[t0, d1..d_{k-1}]`` in
+         ONE k-wide call through its chunked cache path (the same
+         dense mid-sequence branch chunked prefill rides), writing
+         target K/V at ``pos..pos+k-1`` and producing logits
+         ``l_0..l_{k-1}`` where ``g_{j+1} = argmax(l_j)`` is the token
+         plain greedy decode would emit after position ``pos+j``.
+      4. Greedy accept: ``m`` = longest prefix with ``d_j == g_j``
+         (cumprod of the match mask). The slot emits
+         ``[t0, g_1..g_m]`` (``n_emit = 1 + m``) and carries
+         ``last_logits = l_m`` so ``g_{m+1}`` becomes the NEXT tick's
+         ``t0`` — emitted exactly once. K/V written past ``pos+m`` is
+         conditioned on rejected tokens; it is causally masked
+         (kv_pos <= q_pos) and overwritten before the stream ever
+         reaches it, the same partial-tail-garbage discipline as
+         chunked prefill. ``k = 1`` reduces to the base step's math
+         exactly (no proposals, one verify row, ``m = 0``).
+
+    Returns ``(pool_k, pool_v, dpool_k, dpool_v, last_logits, rngs',
+    toks [C, k] i32, n_emit [C] i32)``.
+    """
+    assert cfg.prefill_batch == 1 and cfg.draft is not None
+    mcfg, dcfg = model.cfg, draft_model.cfg
+    spec = cfg.pool_spec
+    L, HKV, HD = mcfg.n_layers, mcfg.n_kv_heads, mcfg.head_dim
+    DL, DHKV, DHD = dcfg.n_layers, dcfg.n_kv_heads, dcfg.head_dim
+    C, P, G, CH = cfg.capacity, spec.block_size, spec.gathered_len, \
+        cfg.prefill_chunk
+    K = cfg.draft.k
+
+    def _draft_one(dparams, tok, kc, vc, pos):
+        logits, (nk, nv) = draft_model.apply(
+            {"params": dparams}, tok[None, None],
+            cache=(kc[:, None], vc[:, None]), pos=pos)
+        k_tok = jax.lax.dynamic_slice_in_dim(nk[:, 0], pos, 1,
+                                             axis=1)[:, 0]
+        v_tok = jax.lax.dynamic_slice_in_dim(nv[:, 0], pos, 1,
+                                             axis=1)[:, 0]
+        return logits[0, 0], k_tok, v_tok
+
+    def _verify_one(params, toks, kc, vc, pos):
+        # the target's K-wide chunk through its own chunked cache path
+        # — the multi-token-advance machinery chunked prefill built
+        logits, (nk, nv) = model.apply(
+            {"params": params}, toks[None],
+            cache=(kc[:, None], vc[:, None]), pos=pos)
+        kw = jax.lax.dynamic_slice_in_dim(nk[:, 0], pos, K, axis=1)
+        vw = jax.lax.dynamic_slice_in_dim(nv[:, 0], pos, K, axis=1)
+        return logits[0], kw, vw
+
+    def step(params, dparams, pool_k, pool_v, dpool_k, dpool_v,
+             last_logits, tables, pos, decoding, temp, top_k, rngs,
+             prefill_slot, prefill_tokens, prefill_pos,
+             prefill_last_row):
+        """One speculative tick. Donated: both pools + last_logits
+        (positions 2-6). Runtime inputs as in the base step."""
+        # ---- t0: the carried token, sampled exactly like the base ---
+        keys = jax.random.wrap_key_data(rngs)
+        split = jax.vmap(jax.random.split)(keys)
+        new_rngs = jnp.where(decoding[:, None],
+                             jax.random.key_data(split[:, 0]), rngs)
+        t0 = jax.vmap(_sample_one)(last_logits, split[:, 1], temp,
+                                   top_k)
+
+        # ---- draft lane: K feedback trips over the draft pool --------
+        def propose(carry, _):
+            dpk, dpv, tok, off = carry
+            gk = dpk[:, tables].reshape(DL, C, G, DHKV, DHD)
+            gv = dpv[:, tables].reshape(DL, C, G, DHKV, DHD)
+            wp = pos + off
+            dlogits, k_tok, v_tok = jax.vmap(
+                _draft_one, in_axes=(None, 0, 1, 1, 0),
+                out_axes=(0, 1, 1),
+            )(dparams, tok, gk, gv, wp)
+            bi = jnp.where(
+                decoding,
+                jnp.take_along_axis(tables, (wp // P)[:, None],
+                                    axis=1)[:, 0],
+                0)
+            woff = jnp.where(decoding, wp % P, 0)
+            dpk = dpk.at[:, bi, woff].set(k_tok)
+            dpv = dpv.at[:, bi, woff].set(v_tok)
+            nxt = jnp.argmax(dlogits, axis=-1).astype(jnp.int32)
+            return (dpk, dpv, nxt, off + 1), tok
+
+        (dpool_k, dpool_v, _, _), chunk = jax.lax.scan(
+            propose, (dpool_k, dpool_v, t0, jnp.int32(0)), None,
+            length=K)
+        chunk = jnp.moveaxis(chunk, 0, 1)   # [C, K] = [t0, d1..d_{K-1}]
+
+        # ---- verify lane: ONE K-wide target chunk per slot -----------
+        gk = pool_k[:, tables].reshape(L, C, G, HKV, HD)
+        gv = pool_v[:, tables].reshape(L, C, G, HKV, HD)
+        vlogits, kw, vw = jax.vmap(
+            _verify_one, in_axes=(None, 0, 1, 1, 0), out_axes=(0, 1, 1),
+        )(params, chunk, gk, gv, pos)        # [C, K, V], [L, C, K, ...]
+        wp = pos[:, None] + jnp.arange(K)[None, :]          # [C, K]
+        bi = jnp.where(decoding[:, None],
+                       jnp.take_along_axis(tables, wp // P, axis=1), 0)
+        woff = jnp.where(decoding[:, None], wp % P, 0)
+        pool_k = pool_k.at[:, bi, woff].set(kw)
+        pool_v = pool_v.at[:, bi, woff].set(vw)
+
+        # ---- greedy accept ------------------------------------------
+        g = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)  # [C, K]
+        ok = jnp.cumprod(
+            (chunk[:, 1:] == g[:, :-1]).astype(jnp.int32), axis=1)
+        m = ok.sum(axis=1).astype(jnp.int32)                # [C]
+        n_emit = jnp.where(decoding, 1 + m, 0).astype(jnp.int32)
+        # emitted stream: t0 then g_1..g_m. g_{m+1} is NOT emitted —
+        # carrying l_m makes it the next tick's t0, emitted once there.
+        toks = jnp.concatenate([t0[:, None], g[:, :-1]], axis=1)
+        picked = jnp.take_along_axis(
+            vlogits, m[:, None, None], axis=1)[:, 0]        # [C, V]
+        last_logits = jnp.where(decoding[:, None], picked, last_logits)
+
+        # ---- prefill lane: reference chunk, target AND draft ---------
+        def do_prefill(pool_k, pool_v, dpool_k, dpool_v, last_logits):
+            slot = jnp.maximum(prefill_slot, 0)
+            row = tables[slot]
+            kc = pool_k[:, row].reshape(L, 1, G, HKV, HD)
+            vc = pool_v[:, row].reshape(L, 1, G, HKV, HD)
+            logits, (nk, nv) = model.apply(
+                {"params": params}, prefill_tokens[None],
+                cache=(kc, vc), pos=prefill_pos)
+            kw = jax.lax.dynamic_slice_in_dim(
+                nk[:, 0], prefill_pos, CH, axis=1)
+            vw = jax.lax.dynamic_slice_in_dim(
+                nv[:, 0], prefill_pos, CH, axis=1)
+            wpos = prefill_pos + jnp.arange(CH)
+            wbi = row[wpos // P]
+            pool_k = pool_k.at[:, wbi, wpos % P].set(kw)
+            pool_v = pool_v.at[:, wbi, wpos % P].set(vw)
+            # the draft rides the SAME chunk/window so its cache tracks
+            # the target position for position — its logits are unused
+            # during prefill (the first proposal each tick feeds t0)
+            dkc = dpool_k[:, row].reshape(DL, 1, G, DHKV, DHD)
+            dvc = dpool_v[:, row].reshape(DL, 1, G, DHKV, DHD)
+            _, (dnk, dnv) = draft_model.apply(
+                {"params": dparams}, prefill_tokens[None],
+                cache=(dkc, dvc), pos=prefill_pos)
+            dkw = jax.lax.dynamic_slice_in_dim(
+                dnk[:, 0], prefill_pos, CH, axis=1)
+            dvw = jax.lax.dynamic_slice_in_dim(
+                dnv[:, 0], prefill_pos, CH, axis=1)
+            dpool_k = dpool_k.at[:, wbi, wpos % P].set(dkw)
+            dpool_v = dpool_v.at[:, wbi, wpos % P].set(dvw)
+            done_row = logits[0, prefill_last_row]
+            finished = prefill_last_row >= 0
+            last_logits = jnp.where(
+                (jnp.arange(C) == slot)[:, None] & finished,
+                done_row[None, :], last_logits)
+            return pool_k, pool_v, dpool_k, dpool_v, last_logits
+
+        pool_k, pool_v, dpool_k, dpool_v, last_logits = jax.lax.cond(
+            prefill_slot >= 0, do_prefill,
+            lambda *a: a, pool_k, pool_v, dpool_k, dpool_v, last_logits)
+        return (pool_k, pool_v, dpool_k, dpool_v, last_logits,
+                new_rngs, toks, n_emit)
+
+    return step
+
+
+def _copy_pool_block(pk, pv, src, dst):
+    """Copy one block's K/V within a (donated) pool pair — the
+    copy-on-write fork primitive. Jitted separately from the step so
+    the engine's `compile_count` pin (== 1) is undisturbed."""
+    return (pk.at[:, dst].set(pk[:, src]),
+            pv.at[:, dst].set(pv[:, src]))
+
+
 def idle_prefill(cfg: EngineConfig):
     """The step's no-prefill sentinel: (slot, tokens, pos, last_row)
     for the single-slot lane, (slots, tokens, pos, last_row, pads) for
@@ -547,7 +772,8 @@ class DecodeEngine:
     def __init__(self, model, params, cfg: EngineConfig,
                  max_seq_len_check: bool = True,
                  use_pallas: Optional[bool] = None,
-                 metrics=None, mesh=None):
+                 metrics=None, mesh=None,
+                 draft_model=None, draft_params=None):
         if max_seq_len_check and cfg.max_slot_len > model.cfg.max_seq_len:
             raise ValueError(
                 f"engine max_slot_len {cfg.max_slot_len} exceeds the "
@@ -582,6 +808,34 @@ class DecodeEngine:
             (cfg.prefill_batch, cfg.prefill_chunk, model.cfg.n_heads,
              model.cfg.head_dim),
             pool_shape, use_pallas)
+        self.draft_model = draft_model
+        self.dpool_k = self.dpool_v = self.draft_params = None
+        if cfg.draft is not None:
+            if draft_model is None or draft_params is None:
+                raise ValueError(
+                    "cfg.draft is set but no draft model/params were "
+                    "given — pass draft_model= and draft_params=")
+            if mesh is not None:
+                raise ValueError(
+                    "speculative decoding requires an unsharded "
+                    "replica (mesh=None)")
+            if draft_model.cfg.vocab_size != model.cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_model.cfg.vocab_size} != "
+                    f"target vocab {model.cfg.vocab_size} — greedy "
+                    "verify compares token ids across the two models")
+            if max_seq_len_check and \
+                    cfg.max_slot_len > draft_model.cfg.max_seq_len:
+                raise ValueError(
+                    f"engine max_slot_len {cfg.max_slot_len} exceeds "
+                    f"the DRAFT model's max_seq_len "
+                    f"{draft_model.cfg.max_seq_len}")
+            # the verify chunk and the draft feedback trips run the
+            # reference lanes only — the fused kernels are single-token
+            # / prefill shaped. Priced honestly: serve.audit's
+            # speculative_plan charges the gathered views.
+            self.fused = False
+            self.fused_prefill = False
         self.cfg = cfg
         self.spec = cfg.pool_spec
         #: replica-group mesh (docs/SERVING.md "sharded replicas"):
@@ -630,10 +884,17 @@ class DecodeEngine:
             # concrete device keeps every signature
             # SingleDeviceSharding from the first tick on.
             self.params = jax.device_put(params, jax.devices()[0])
-            self._step = jax.jit(
-                build_step(model, cfg, fused=self.fused,
-                           fused_prefill=self.fused_prefill),
-                donate_argnums=(1, 2, 3))
+            if cfg.draft is not None:
+                # donated: both pools + last_logits (positions 2-6 of
+                # the spec signature — params/draft params stay)
+                self._step = jax.jit(
+                    build_spec_step(model, draft_model, cfg),
+                    donate_argnums=(2, 3, 4, 5, 6))
+            else:
+                self._step = jax.jit(
+                    build_step(model, cfg, fused=self.fused,
+                               fused_prefill=self.fused_prefill),
+                    donate_argnums=(1, 2, 3))
             # COMMIT the device-resident buffers to the same device as
             # the weights: a fresh jnp.zeros is uncommitted, but the
             # step's outputs are committed, so an uncommitted
@@ -649,6 +910,14 @@ class DecodeEngine:
                 jnp.zeros((cfg.capacity, model.cfg.vocab_size),
                           jnp.float32),
                 device)
+            if cfg.draft is not None:
+                self.draft_params = jax.device_put(draft_params, device)
+                dpk, dpv = init_pool(draft_model.cfg, self.spec)
+                self.dpool_k = jax.device_put(dpk, device)
+                self.dpool_v = jax.device_put(dpv, device)
+        # the copy-on-write fork primitive (scheduler-driven): its own
+        # tiny jit so the step's compile_count pin is undisturbed
+        self._copy = jax.jit(_copy_pool_block, donate_argnums=(0, 1))
         self.steps = 0
         # live metrics (telemetry/metrics.py): per-tick prefill/decode
         # token counts + the compile counter. The registry NEVER enters
@@ -704,15 +973,36 @@ class DecodeEngine:
             pad=np.zeros(C, np.int32),
         )
 
+    # ---- copy-on-write fork ----------------------------------------------
+
+    def copy_block(self, src: int, dst: int) -> None:
+        """Copy pool block ``src`` into ``dst`` (K and V; the draft
+        pool too when speculative decoding is armed) — the scheduler's
+        fork primitive: before a prefill chunk's write window touches a
+        block with refcount > 1, the slot's table is repointed at a
+        fresh block populated by this copy, so a shared block is never
+        written by a non-exclusive owner."""
+        s, d = jnp.int32(src), jnp.int32(dst)
+        self.pool_k, self.pool_v = self._copy(self.pool_k, self.pool_v,
+                                              s, d)
+        if self.dpool_k is not None:
+            self.dpool_k, self.dpool_v = self._copy(
+                self.dpool_k, self.dpool_v, s, d)
+
     # ---- the tick --------------------------------------------------------
 
     def tick(self, tables, pos, decoding, temp, top_k, rngs, prefill,
              pad=None):
-        """Run one step; returns (emitted [C] i32 np, rngs' [C, 2] u32
-        np). The donated device buffers are swapped internally. ``pad``
-        ([C] i32 per-slot left pad) exists only on the batched-prefill
-        program (prefill_batch > 1) and is ignored otherwise — the
-        single-slot program is the historical one, with no pad inputs."""
+        """Run one step; returns ``(toks [C, W] i32 np, n_emit [C] i32
+        np, rngs' [C, 2] u32 np)`` — ``toks[s, :n_emit[s]]`` are slot
+        s's tokens this tick, oldest first. W == 1 on the base step
+        (``n_emit`` = the decoding mask); W == cfg.draft.k on a
+        speculative engine, where ``n_emit`` counts the carried token
+        plus accepted proposals. The donated device buffers are swapped
+        internally. ``pad`` ([C] i32 per-slot left pad) exists only on
+        the batched-prefill program (prefill_batch > 1) and is ignored
+        otherwise — the single-slot program is the historical one, with
+        no pad inputs."""
         if self.mesh is None:
             put = jnp.asarray
         else:
@@ -722,10 +1012,19 @@ class DecodeEngine:
             # placement, no wire traffic
             def put(x):
                 return _global_put(x, self._repl_sh)
-        common = (
-            self.params, self.pool_k, self.pool_v, self.last_logits,
-            put(tables), put(pos), put(decoding),
-            put(temp), put(top_k), put(rngs))
+        spec_mode = self.cfg.draft is not None
+        if spec_mode:
+            common = (
+                self.params, self.draft_params, self.pool_k,
+                self.pool_v, self.dpool_k, self.dpool_v,
+                self.last_logits,
+                put(tables), put(pos), put(decoding),
+                put(temp), put(top_k), put(rngs))
+        else:
+            common = (
+                self.params, self.pool_k, self.pool_v, self.last_logits,
+                put(tables), put(pos), put(decoding),
+                put(temp), put(top_k), put(rngs))
         if self.cfg.prefill_batch == 1:
             pslot, ptoks, ppos, plast = prefill
             args = common + (put(pslot), put(ptoks),
@@ -737,17 +1036,27 @@ class DecodeEngine:
             args = common + (put(pad), put(pslot),
                              put(ptoks), put(ppos),
                              put(plast), put(ppad))
-        (self.pool_k, self.pool_v, self.last_logits, new_rngs,
-         emitted) = self._step(*args)
+        if spec_mode:
+            (self.pool_k, self.pool_v, self.dpool_k, self.dpool_v,
+             self.last_logits, new_rngs, toks, n_emit) = \
+                self._step(*args)
+            toks = np.array(toks)
+            n_emit = np.array(n_emit)
+        else:
+            (self.pool_k, self.pool_v, self.last_logits, new_rngs,
+             emitted) = self._step(*args)
         self.steps += 1
         m = self.metrics
         if m.enabled:
             # counted from the HOST-OWNED inputs this call received —
-            # the device outputs above stay un-inspected, so metrics
-            # adds zero host syncs. prefill_tokens counts chunk
-            # positions advanced (incl. pad columns on the batched
-            # lane); decode_tokens counts slots that sampled.
-            n_dec = int(np.sum(np.asarray(decoding)))
+            # the device outputs above stay un-inspected on the base
+            # step, so metrics adds zero host syncs (the spec step's
+            # n_emit is already a host-fetched output the scheduler
+            # needs anyway). prefill_tokens counts chunk positions
+            # advanced (incl. pad columns on the batched lane);
+            # decode_tokens counts tokens emitted.
+            n_dec = int(n_emit.sum()) if spec_mode else \
+                int(np.sum(np.asarray(decoding)))
             if self.cfg.prefill_batch == 1:
                 n_pf_rows = 1 if int(prefill[0]) >= 0 else 0
             else:
@@ -759,10 +1068,16 @@ class DecodeEngine:
                         n_pf_rows * self.cfg.prefill_chunk)
             m.gauge("engine_steps", self.steps)
             m.gauge("compile_count", self.compile_count)
+        if spec_mode:
+            return toks, n_emit, np.array(new_rngs)
         if self.mesh is not None:
             # replicated outputs: any addressable shard IS the global
             # value — np.array on a multi-process global array would
             # raise (non-addressable devices)
-            return (np.array(emitted.addressable_data(0)),
-                    np.array(new_rngs.addressable_data(0)))
-        return np.array(emitted), np.array(new_rngs)
+            emitted = np.array(emitted.addressable_data(0))
+            new_rngs = np.array(new_rngs.addressable_data(0))
+        else:
+            emitted = np.array(emitted)
+            new_rngs = np.array(new_rngs)
+        return (emitted[:, None],
+                np.asarray(decoding).astype(np.int32), new_rngs)

@@ -29,7 +29,9 @@ parity with single-stream `generate` rests on this (docs/SERVING.md
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional
+import hashlib
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence
 
 import jax.numpy as jnp
 import numpy as np
@@ -189,37 +191,177 @@ def serve_kv_plan_bytes(cfg, spec: PagedPoolSpec, capacity: int,
 
 
 class BlockAllocator:
-    """Host-side free-list over the pool's blocks. Block 0 (scratch) is
-    never handed out. Pure bookkeeping — the device never sees this
-    object, only the int32 tables the scheduler builds from it."""
+    """Host-side free-list over the pool's blocks, with per-block
+    REFCOUNTS so prefix sharing can map one physical block into many
+    slot tables (docs/SERVING.md "prefix sharing"). Block 0 (scratch)
+    is never handed out. Pure bookkeeping — the device never sees this
+    object, only the int32 tables the scheduler builds from it.
+
+    ``alloc`` grants blocks at refcount 1; ``incref`` adds a sharer;
+    ``decref`` (and its alias ``free``) drops one reference and returns
+    the block to the free list only when the LAST reference dies. A
+    decref of a block that is already free refuses with the same
+    "double free" error the unref'd allocator raised — releasing a
+    reference you do not hold is the bookkeeping bug that silently
+    corrupts a *different* request's cache."""
 
     def __init__(self, spec: PagedPoolSpec):
         self.spec = spec
         self._free: List[int] = list(range(1, spec.n_blocks))
+        #: block id -> live reference count (allocated blocks only)
+        self._refs: Dict[int, int] = {}
 
     @property
     def free_blocks(self) -> int:
         return len(self._free)
 
+    def refcount(self, b: int) -> int:
+        """Live references on block ``b`` (0 when free)."""
+        return self._refs.get(int(b), 0)
+
     def alloc(self, n: int) -> Optional[List[int]]:
-        """``n`` block ids, or None when the pool cannot satisfy the
-        request (the caller defers admission / preempts — never a
-        partial grant, which would strand blocks on a failed admit)."""
+        """``n`` block ids at refcount 1, or None when the pool cannot
+        satisfy the request (the caller defers admission / preempts —
+        never a partial grant, which would strand blocks on a failed
+        admit)."""
         if n < 0:
             raise ValueError(f"alloc({n})")
         if n > len(self._free):
             return None
         ids, self._free = self._free[:n], self._free[n:]
+        for b in ids:
+            self._refs[b] = 1
         return ids
 
-    def free(self, ids) -> None:
+    def incref(self, ids) -> None:
+        """Add one reference per id — mapping an already-resident block
+        into another slot's table (prefix sharing)."""
+        for b in ids:
+            b = int(b)
+            if self._refs.get(b, 0) < 1:
+                raise ValueError(f"incref of unallocated block {b}")
+            self._refs[b] += 1
+
+    def decref(self, ids) -> List[int]:
+        """Drop one reference per id; returns the ids whose LAST
+        reference died (now back on the free list)."""
+        freed: List[int] = []
         for b in ids:
             b = int(b)
             if b <= 0 or b >= self.spec.n_blocks:
                 raise ValueError(f"freeing invalid block {b}")
-            if b in self._free:
+            rc = self._refs.get(b, 0)
+            if rc < 1:
                 raise ValueError(f"double free of block {b}")
-            self._free.append(b)
+            if rc == 1:
+                del self._refs[b]
+                self._free.append(b)
+                freed.append(b)
+            else:
+                self._refs[b] = rc - 1
+        return freed
+
+    def free(self, ids) -> None:
+        """Alias for :meth:`decref` — every historical release site
+        (retirement, preemption, drain-eviction) is one dropped
+        reference, which only *frees* when nothing shares the block."""
+        self.decref(ids)
+
+
+def prefix_block_hashes(tokens, block_size: int) -> List[bytes]:
+    """Cumulative digest per FULL block of ``tokens``: digest ``i``
+    identifies tokens ``0 .. (i+1)*block_size`` as a chain, so equal
+    digests imply equal prefixes (not merely equal blocks — K/V at
+    position ``p`` depends on every earlier token, so a block is only
+    shareable together with its whole prefix). hashlib keeps the key
+    deterministic across processes, unlike Python's seeded ``hash``."""
+    toks = np.asarray(tokens, dtype=np.int32).reshape(-1)
+    out: List[bytes] = []
+    h = b""
+    for i in range(toks.size // block_size):
+        chunk = toks[i * block_size:(i + 1) * block_size].tobytes()
+        h = hashlib.sha1(h + chunk).digest()
+        out.append(h)
+    return out
+
+
+class PrefixCache:
+    """Prompt-prefix → block-chain cache over one :class:`BlockAllocator`
+    (docs/SERVING.md "prefix sharing").
+
+    Maps the cumulative token-hash of each FULL prompt block to the
+    pool block holding its K/V. The cache holds exactly ONE reference
+    per cached block, so a cached chain outlives the request that
+    prefilled it and a later request with the same prefix re-attaches
+    by ``incref`` instead of re-prefilling. Entries are LRU-ordered;
+    eviction frees only blocks at refcount 1 (the cache is the sole
+    holder — a block some live slot still maps is never yanked)."""
+
+    def __init__(self, alloc: BlockAllocator):
+        self.alloc = alloc
+        #: digest -> block id, oldest-touched first (LRU order)
+        self._chain: "OrderedDict[bytes, int]" = OrderedDict()
+        #: counters for shared_block_fraction / the smoke's
+        #: prefill-once assertion (host bookkeeping only)
+        self.shared_tokens = 0
+        self.prompt_tokens = 0
+
+    def __len__(self) -> int:
+        return len(self._chain)
+
+    def match(self, hashes: Sequence[bytes],
+              max_blocks: Optional[int] = None) -> List[int]:
+        """Longest cached chain prefix of ``hashes`` (block ids, in
+        chain order), capped at ``max_blocks``. Touches hits for LRU."""
+        blocks: List[int] = []
+        limit = len(hashes) if max_blocks is None else min(
+            max_blocks, len(hashes))
+        for h in hashes[:limit]:
+            b = self._chain.get(h)
+            if b is None:
+                break
+            self._chain.move_to_end(h)
+            blocks.append(b)
+        return blocks
+
+    def register(self, hashes: Sequence[bytes], blocks: Sequence[int]
+                 ) -> None:
+        """Publish a prefilled chain: cache each (digest, block) pair
+        not yet present, taking one reference per newly cached block. A
+        digest already cached under a DIFFERENT block (two requests
+        racing the same prefix through separate slots) keeps the first
+        publication — the duplicate's blocks stay owned by its slot."""
+        for h, b in zip(hashes, blocks):
+            if h in self._chain:
+                self._chain.move_to_end(h)
+                continue
+            self.alloc.incref([b])
+            self._chain[h] = int(b)
+
+    def evict(self, n_blocks: int) -> int:
+        """Free up to ``n_blocks`` pool blocks by dropping LRU entries
+        whose block the cache alone holds (refcount 1). Entries whose
+        block is still shared by a live slot are skipped — their chain
+        suffix may become unreachable until they age out, which is
+        bounded by the same LRU walk. Returns blocks actually freed."""
+        freed = 0
+        for h in list(self._chain):
+            if freed >= n_blocks:
+                break
+            b = self._chain[h]
+            if self.alloc.refcount(b) == 1:
+                del self._chain[h]
+                self.alloc.decref([b])
+                freed += 1
+        return freed
+
+    @property
+    def shared_block_fraction(self) -> float:
+        """Fraction of admitted prompt tokens served from cached
+        chains instead of prefill (0.0 when nothing shared)."""
+        if not self.prompt_tokens:
+            return 0.0
+        return self.shared_tokens / self.prompt_tokens
 
 
 def new_block_table(spec: PagedPoolSpec, capacity: int) -> np.ndarray:

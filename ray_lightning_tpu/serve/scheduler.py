@@ -21,7 +21,12 @@ import jax
 import numpy as np
 
 from ray_lightning_tpu.serve.engine import DecodeEngine, idle_prefill
-from ray_lightning_tpu.serve.kv_cache import BlockAllocator, new_block_table
+from ray_lightning_tpu.serve.kv_cache import (
+    BlockAllocator,
+    PrefixCache,
+    new_block_table,
+    prefix_block_hashes,
+)
 from ray_lightning_tpu.telemetry.metrics import NULL_FLIGHT, NULL_METRICS
 
 
@@ -69,7 +74,8 @@ class Completion:
 
 class _Slot:
     __slots__ = ("req", "blocks", "emitted", "prefill_next",
-                 "admitted_at", "first_token_at", "preempted", "seq")
+                 "admitted_at", "first_token_at", "preempted", "seq",
+                 "shared_blocks", "hashes")
 
     def __init__(self, req: Request, blocks: List[int], preempted: int,
                  seq: int):
@@ -83,6 +89,12 @@ class _Slot:
         #: admission order — the preemption policy's age (monotonic,
         #: tie-free where wall clocks are not)
         self.seq = seq
+        #: leading blocks mapped from the prefix cache at admission
+        #: (their prefill was skipped); shrinks if a fork copies one
+        self.shared_blocks = 0
+        #: cumulative prompt-block digests (prefix_block_hashes) —
+        #: kept for registration when prefill completes
+        self.hashes: List[bytes] = []
 
 
 @dataclasses.dataclass
@@ -110,6 +122,15 @@ def validate_request(cfg, spec, req: Request) -> None:
     head-of-line-blocking the replica — review finding, test-pinned).
     ``cfg`` is the `EngineConfig`, ``spec`` its pool spec."""
     total = req.prompt.size + req.max_new_tokens
+    if cfg.draft is not None:
+        if req.temperature != 0.0:
+            raise ValueError(
+                f"request {req.rid}: speculative decoding is "
+                f"greedy-only (temperature 0), got "
+                f"{req.temperature}")
+        # the verify chunk writes k positions from the LAST decode pos
+        # — k-1 headroom keeps the window inside the slot
+        total += cfg.draft.k - 1
     padded = ""
     if cfg.prefill_batch > 1:
         # batched prefill right-aligns the prompt to a chunk multiple
@@ -152,9 +173,19 @@ class Scheduler:
     """
 
     def __init__(self, engine: DecodeEngine, reserve: str = "worst_case",
-                 metrics=None, flight=None):
+                 metrics=None, flight=None, prefix_cache: bool = False):
         if reserve not in ("worst_case", "on_demand"):
             raise ValueError(f"reserve={reserve!r}")
+        if prefix_cache and engine.cfg.prefill_batch != 1:
+            raise ValueError(
+                "prefix_cache=True requires prefill_batch == 1 — the "
+                "batched lane's left-pad alignment shifts block "
+                "boundaries per group, so chains never line up")
+        if prefix_cache and engine.mesh is not None:
+            raise ValueError(
+                "prefix_cache=True requires an unsharded replica "
+                "(mesh=None) — the fork copy is a single-device "
+                "primitive")
         #: live metrics (telemetry/metrics.py): per-tick gauges + event
         #: counters + completion latency histograms — every recorded
         #: value is a plain host scalar the tick computed anyway, so
@@ -170,6 +201,20 @@ class Scheduler:
         self.spec = engine.spec
         self.reserve = reserve
         self.alloc = BlockAllocator(self.spec)
+        #: prompt-prefix -> block-chain cache (docs/SERVING.md "prefix
+        #: sharing"): admission maps a matched chain into the slot's
+        #: table by incref and prefills only the divergent tail
+        self.prefix: Optional[PrefixCache] = (
+            PrefixCache(self.alloc) if prefix_cache else None)
+        #: tokens the verify chunk advances per tick (1 = base engine)
+        self._spec_k = (self.cfg.draft.k
+                        if self.cfg.draft is not None else 1)
+        #: REAL prompt positions advanced through the prefill lane —
+        #: the prefill-once assertion's counter (shared prefixes are
+        #: admitted at pos > 0 and never re-issued)
+        self.prefill_tokens_issued = 0
+        self._emitted_total = 0
+        self._decode_slot_steps = 0
         C = self.cfg.capacity
         self.tables = new_block_table(self.spec, C)
         self.pos = np.zeros(C, np.int32)
@@ -301,23 +346,64 @@ class Scheduler:
             span = min(-(-width // ch) * ch, self.cfg.max_slot_len)
         return -(-span // self.spec.block_size)
 
+    def _alloc_or_evict(self, n: int) -> Optional[List[int]]:
+        """`BlockAllocator.alloc` with the prefix cache as the relief
+        valve: when the free list is short, LRU cache entries whose
+        block nothing else holds (refcount 1) are evicted to cover the
+        shortfall before the caller defers or preempts."""
+        if n <= 0:
+            return []
+        got = self.alloc.alloc(n)
+        if got is None and self.prefix is not None:
+            self.prefix.evict(n - self.alloc.free_blocks)
+            got = self.alloc.alloc(n)
+        return got
+
     def _admit_one(self, width: int) -> Optional[int]:
         """Admit the queue head into a free slot with blocks reserved
         for ``width`` prefill positions. Returns the slot id, or None
-        when the pool is short (FIFO holds)."""
+        when the pool is short (FIFO holds).
+
+        With the prefix cache armed, the prompt's cumulative block
+        digests are matched against cached chains first: matched FULL
+        blocks map into the slot's table by incref (their prefill is
+        skipped — ``pos`` starts past them), capped one block short of
+        the prompt end so the slot's OWN final chunk always runs and
+        computes ``last_logits``. A failed owned-tail allocation
+        decrefs the held match exactly — a deferred admission leaks
+        nothing."""
         req, preempts = self.queue[0]
-        blocks = self.alloc.alloc(self._blocks_needed_at_admit(req,
-                                                               width))
+        matched: List[int] = []
+        hashes: List[bytes] = []
+        if self.prefix is not None:
+            P = self.spec.block_size
+            hashes = prefix_block_hashes(req.prompt, P)
+            cap = (req.prompt.size - 1) // P
+            matched = self.prefix.match(hashes, max_blocks=cap)
+        n_need = self._blocks_needed_at_admit(req, width) - len(matched)
+        # hold the matched chain (incref) BEFORE the tail allocation:
+        # the allocation may evict LRU cache entries, and an unheld
+        # match at refcount 1 would be evictable out from under us
+        if matched:
+            self.alloc.incref(matched)
+        blocks = self._alloc_or_evict(n_need)
         if blocks is None:
+            if matched:
+                self.alloc.decref(matched)
             return None  # pool short: keep FIFO order, retry next tick
+        n_shared = len(matched) * self.spec.block_size
+        blocks = matched + blocks
         self.queue.popleft()
         s = self.free_slots.pop(0)
         self._seq += 1
         slot = _Slot(req, blocks, preempts, self._seq)
+        slot.shared_blocks = len(matched)
+        slot.hashes = hashes
+        slot.prefill_next = n_shared
         self.slots[s] = slot
         self.tables[s, :] = 0
         self.tables[s, :len(blocks)] = blocks
-        self.pos[s] = 0
+        self.pos[s] = n_shared
         self.decoding[s] = False
         self.pad[s] = width - req.prompt.size
         self.temp[s] = req.temperature
@@ -325,9 +411,16 @@ class Scheduler:
         self.rngs[s] = _key_data(req.seed)
         self._queue_wait[req.rid] = (
             slot.admitted_at - req.arrival if req.arrival else 0.0)
+        if self.prefix is not None:
+            self.prefix.prompt_tokens += int(req.prompt.size)
+            self.prefix.shared_tokens += n_shared
+            if n_shared:
+                self.metrics.count("prefix_hits")
+                self.metrics.count("shared_prompt_tokens", n_shared)
         self.metrics.count("admissions")
         self.flight.record("admit", rid=req.rid, slot=s,
-                           blocks=len(blocks), preempted=preempts)
+                           blocks=len(blocks), preempted=preempts,
+                           shared=len(matched))
         return s
 
     def _admit(self) -> None:
@@ -376,16 +469,51 @@ class Scheduler:
             self.prefill_groups.append(_PrefillGroup(group, width))
 
     def _grow(self, s: int, slot: _Slot) -> bool:
-        """Ensure the block covering ``pos`` exists before a decode
-        write. True = ok, False = pool empty (caller preempts)."""
-        idx = int(self.pos[s]) // self.spec.block_size
-        if idx < len(slot.blocks):
-            return True
-        got = self.alloc.alloc(1)
-        if got is None:
-            return False
-        slot.blocks.extend(got)
-        self.tables[s, idx] = got[0]
+        """Ensure every block a decode write can touch this tick
+        exists: positions ``pos .. pos + spec_k - 1`` (k == 1 on the
+        base engine — the historical one-block growth). True = ok,
+        False = pool empty (caller preempts)."""
+        idx = (int(self.pos[s]) + self._spec_k - 1) \
+            // self.spec.block_size
+        while len(slot.blocks) <= idx:
+            got = self._alloc_or_evict(1)
+            if got is None:
+                return False
+            self.tables[s, len(slot.blocks)] = got[0]
+            slot.blocks.extend(got)
+        return True
+
+    def _fork_for_window(self, s: int, slot: _Slot, start: int) -> bool:
+        """Copy-on-write: before the prefill chunk's FULL ``ch``-wide
+        window ``[start, start + ch)`` is written, any block in the
+        window with refcount > 1 (shared with the prefix cache or a
+        sibling slot) is forked — copied into a fresh block the slot
+        repoints its table at — so a non-exclusive block is never
+        written. Reached only when the window slides back across the
+        shared prefix (prompt near the slot end); the rewrite is
+        value-identical on the reference path, but forking keeps the
+        invariant robust on every path. True = ok, False = pool dry
+        (caller preempts the prefilling slot)."""
+        P = self.spec.block_size
+        lo = start // P
+        hi = min((start + self.cfg.prefill_chunk - 1) // P,
+                 len(slot.blocks) - 1)
+        for bi in range(lo, hi + 1):
+            b = slot.blocks[bi]
+            if self.alloc.refcount(b) <= 1:
+                continue
+            got = self._alloc_or_evict(1)
+            if got is None:
+                return False
+            self.engine.copy_block(b, got[0])
+            slot.blocks[bi] = got[0]
+            self.tables[s, bi] = got[0]
+            self.alloc.decref([b])
+            if bi < slot.shared_blocks:
+                slot.shared_blocks = bi
+            self.metrics.count("block_forks")
+            self.flight.record("fork", rid=slot.req.rid, slot=s,
+                               block=int(b), copy=int(got[0]))
         return True
 
     def _preempt(self, s: int) -> None:
@@ -510,13 +638,21 @@ class Scheduler:
             # recompute bitwise-identical K/V: each row's causal mask
             # restricts it to the same context as its original pass.
             start = min(ppos, self.cfg.max_slot_len - ch)
-            n_win = min(ch, ptoks.size - start)
-            chunk = np.zeros(ch, np.int32)
-            chunk[:n_win] = ptoks[start:start + n_win]
-            finished = ppos + chunk_len >= ptoks.size
-            last_row = (ptoks.size - 1 - start) if finished else -1
-            prefill = (np.int32(pf_slot), chunk, np.int32(start),
-                       np.int32(last_row))
+            if self.prefix is not None and not self._fork_for_window(
+                    pf_slot, slot, start):
+                # pool dry under a copy-on-write fork: bounce the
+                # prefilling request back to the queue (deterministic
+                # replay) and run this tick without a prefill chunk
+                self._preempt(pf_slot)
+                pf_group = None
+            else:
+                n_win = min(ch, ptoks.size - start)
+                chunk = np.zeros(ch, np.int32)
+                chunk[:n_win] = ptoks[start:start + n_win]
+                finished = ppos + chunk_len >= ptoks.size
+                last_row = (ptoks.size - 1 - start) if finished else -1
+                prefill = (np.int32(pf_slot), chunk, np.int32(start),
+                           np.int32(last_row))
         elif pf_group is not None:
             # batched lane: the head group advances one shared chunk;
             # every row's LEFT-padded prompt is right-aligned to the
@@ -543,7 +679,7 @@ class Scheduler:
             prefill = (slots_arr, toks, np.int32(start),
                        np.int32(last_row), pads)
         was_decoding = self.decoding.copy()
-        emitted, self.rngs = self.engine.tick(
+        emitted, n_emit, self.rngs = self.engine.tick(
             self.tables, self.pos, self.decoding, self.temp, self.top_k,
             self.rngs, prefill,
             pad=self.pad if self.cfg.prefill_batch > 1 else None)
@@ -556,35 +692,53 @@ class Scheduler:
             chunk_len = min(ch, slot.req.prompt.size - slot.prefill_next)
             slot.prefill_next += chunk_len
             self.pos[pf_slot] += chunk_len
+            self.prefill_tokens_issued += chunk_len
             if slot.prefill_next >= slot.req.prompt.size:
                 self.prefill_groups.popleft()
                 self.decoding[pf_slot] = True
+                if self.prefix is not None:
+                    # publish the fully prefilled chain: every FULL
+                    # prompt block becomes matchable for later admits
+                    n_full = (slot.req.prompt.size
+                              // self.spec.block_size)
+                    self.prefix.register(slot.hashes[:n_full],
+                                         slot.blocks[:n_full])
         elif pf_group is not None:
             pf_group.next += ch
             for s in pf_group.slots:
                 self.pos[s] += ch  # cache positions incl. pad columns
+            self.prefill_tokens_issued += ch * len(pf_group.slots)
             if pf_group.next >= pf_group.width:
                 self.prefill_groups.popleft()
                 for s in pf_group.slots:
                     self.decoding[s] = True
-        # decode accounting
+        # decode accounting — the engine hands back up to W tokens per
+        # slot (W == 1 on the base step): append in order, truncating
+        # at eos / max_new exactly where plain greedy decode stops
         done: List[Completion] = []
         self.last_emissions = []
+        n_active = int(was_decoding.sum())
+        if n_active:
+            self._decode_slot_steps += n_active
+            self._emitted_total += int(n_emit[was_decoding].sum())
         for s in list(self.slots):
             if not was_decoding[s]:
                 continue
             slot = self.slots[s]
-            tok = int(emitted[s])
             if slot.first_token_at is None:
                 slot.first_token_at = time.perf_counter()
-            slot.emitted.append(tok)
-            self.last_emissions.append((slot.req.rid, tok))
-            self.pos[s] += 1
             req = slot.req
-            if req.eos_id is not None and tok == req.eos_id:
-                done.append(self._retire(s, "eos"))
-            elif len(slot.emitted) >= req.max_new_tokens:
-                done.append(self._retire(s, "length"))
+            for _j in range(int(n_emit[s])):
+                tok = int(emitted[s, _j])
+                slot.emitted.append(tok)
+                self.last_emissions.append((req.rid, tok))
+                self.pos[s] += 1
+                if req.eos_id is not None and tok == req.eos_id:
+                    done.append(self._retire(s, "eos"))
+                    break
+                if len(slot.emitted) >= req.max_new_tokens:
+                    done.append(self._retire(s, "length"))
+                    break
         m = self.metrics
         if m.enabled or self.flight.enabled:
             # every value below is host bookkeeping the tick already
@@ -616,6 +770,24 @@ class Scheduler:
     def slot_occupancy(self) -> float:
         """Mean decoding-slot fraction over all ticks so far."""
         return self._occupancy_sum / max(1, self._ticks)
+
+    @property
+    def shared_block_fraction(self) -> float:
+        """Fraction of admitted prompt tokens served from the prefix
+        cache instead of the prefill lane (0.0 with the cache off or
+        when no prompts shared a prefix)."""
+        return (self.prefix.shared_block_fraction
+                if self.prefix is not None else 0.0)
+
+    @property
+    def accepted_tokens_per_step(self) -> float:
+        """Mean tokens emitted per decoding slot per engine tick —
+        exactly 1.0 on the base engine, ``1 + mean accepted
+        proposals`` under speculative decoding (the throughput
+        multiplier the draft buys)."""
+        if not self._decode_slot_steps:
+            return 1.0
+        return self._emitted_total / self._decode_slot_steps
 
     def _partial_timing(self, slot: _Slot, now: float,
                         preempted: int) -> dict:

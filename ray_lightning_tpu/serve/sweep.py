@@ -10,9 +10,13 @@ cannot answer from byte math, so this module measures it:
 
   * **correctness matrix** — every candidate geometry runs BOTH
     kernels in interpret mode (`dispatch.force_pallas` off-TPU)
-    against their XLA reference twins on a deterministic random case.
-    This works on any host, including CPU CI, and is the part the
-    tier-1 tests pin (`tests/test_paged_prefill.py`).
+    against their XLA reference twins on a deterministic random case,
+    plus a ``shared_spec`` cell replaying the decode gather through a
+    FORKED table (slots aliasing a shared prefix chain — the prefix
+    cache's copy-on-write geometry) and the speculative verify's
+    k-wide chunk where the prefill kernel tiles it. This works on any
+    host, including CPU CI, and is the part the tier-1 tests pin
+    (`tests/test_paged_prefill.py`).
   * **wall-clock timing** — on a real TPU backend each correct
     candidate's kernels are jitted, warmed, and timed best-of-N;
     without one the timing leg degrades to a structured
@@ -176,6 +180,61 @@ def _correctness_case(model_cfg, engine_cfg, cand: SweepCandidate,
             out["prefill"] = {"ok": False,
                               "error": f"{type(exc).__name__}: "
                                        f"{str(exc)[:160]}"}
+
+    # shared-prefix + speculative cell: the prefix cache makes slots
+    # ALIAS each other's prefix blocks (fork-on-write tables), so the
+    # decode kernel must gather correctly through an aliased table —
+    # every slot's first half points at slot 0's chain, tails stay
+    # owned. Piggybacked: the speculative verify is a NARROW k-wide
+    # chunk mid-slot; where the prefill kernel tiles that width the
+    # pair must agree there too (where it does not, the engine runs
+    # the verify on the reference lane — recorded as a skip, not a
+    # failure).
+    forked = np.asarray(tables).copy()
+    half = max(1, M // 2)
+    forked[:, :half] = forked[0, :half]
+    forked = jnp.asarray(forked, jnp.int32)
+    if not paged_shapes_supported((C, H, HD), (n_blocks, P, HKV, HD)):
+        out["shared_spec"] = {
+            "ok": False, "error": "shape not supported by the kernel"}
+    else:
+        try:
+            ref = paged_attention_reference(q1, pool_k, pool_v, forked,
+                                            lengths, pads)
+            with dispatch.force_pallas():
+                got = paged_attention_pallas(q1, pool_k, pool_v,
+                                             forked, lengths, pads)
+            err = float(jnp.max(jnp.abs(got - ref)))
+            cell = {"ok": bool(err < 2e-5), "max_err": err}
+            K = 4                       # DraftConfig's default k
+            qk = jnp.asarray(rng.normal(size=(B, K, H, HD)),
+                             jnp.float32)
+            vpos = max(0, min(cand.span - K, cand.span // 2))
+            vpad = jnp.zeros((B,), jnp.int32)
+            if paged_prefill_shapes_supported(
+                    (B, K, H, HD), (n_blocks, P, HKV, HD)):
+                refv = paged_prefill_reference(qk, pool_k, pool_v,
+                                               forked[:B], vpos,
+                                               pad=vpad)
+                with dispatch.force_pallas():
+                    gotv = paged_prefill_pallas(qk, pool_k, pool_v,
+                                                forked[:B], vpos,
+                                                pad=vpad)
+                verr = float(jnp.max(jnp.abs(gotv - refv)))
+                cell["verify_chunk"] = {"ok": bool(verr < 2e-5),
+                                        "max_err": verr}
+                cell["ok"] = bool(cell["ok"]
+                                  and cell["verify_chunk"]["ok"])
+            else:
+                cell["verify_chunk"] = {
+                    "skipped": "k-wide chunk not tiled — the "
+                               "speculative verify runs the "
+                               "reference lane"}
+            out["shared_spec"] = cell
+        except Exception as exc:  # noqa: BLE001 — recorded, not raised
+            out["shared_spec"] = {"ok": False,
+                                  "error": f"{type(exc).__name__}: "
+                                           f"{str(exc)[:160]}"}
     return out
 
 
@@ -271,7 +330,8 @@ def sweep_paged_kernels(model_cfg, engine_cfg, *,
             **_correctness_case(model_cfg, engine_cfg, cand),
         }
         ok = (entry["decode"].get("ok")
-              and entry["prefill"].get("ok"))
+              and entry["prefill"].get("ok")
+              and entry["shared_spec"].get("ok"))
         if timed and ok:
             try:
                 entry["timing"] = _time_candidate(
@@ -285,7 +345,8 @@ def sweep_paged_kernels(model_cfg, engine_cfg, *,
         results.append(entry)
 
     passing = [r for r in results
-               if r["decode"].get("ok") and r["prefill"].get("ok")]
+               if r["decode"].get("ok") and r["prefill"].get("ok")
+               and r["shared_spec"].get("ok")]
     winner, source = None, None
     measured = [r for r in passing
                 if "decode_wall_s" in (r.get("timing") or {})]

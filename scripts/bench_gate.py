@@ -77,6 +77,13 @@ RATCHETED = {
     # serving leg (ISSUE 8): steady-state continuous-batching decode
     # throughput — measured, so waived on environmental skip lines
     "decode_tokens_per_s": "decode_tokens_per_s",
+    # ISSUE 19: the prefix cache's measured sharing on the saturated
+    # steady-state leg (fraction of mapped blocks that were shared —
+    # may only grow), and tokens emitted per decoding slot-step
+    # (exactly 1.0 without a draft, > 1.0 once speculative acceptance
+    # lands — may only grow). Both measured: waived on skip lines.
+    "shared_block_fraction": "shared_block_fraction",
+    "accepted_tokens_per_step": "accepted_tokens_per_step",
 }
 
 #: keys computed by static analysis (no hardware needed) — carried on
@@ -136,7 +143,9 @@ CEILING_WHY = {
     "serve_hbm_bytes_per_replica": (
         "per-replica serving HBM may only shrink — the fused paged "
         "decode + prefill kernels retired the dense gathered views "
-        "and nothing may quietly grow them back"),
+        "and nothing may quietly grow them back (the ceiling prices "
+        "the full unshared pool: prefix sharing SAVES bytes inside "
+        "it, so sharing can never excuse a bigger plan)"),
     "serve_prefill_gather_bytes": (
         "the prefill lane's dense per-group gather is retired by the "
         "fused paged-prefill kernel — its bytes may only shrink, and "

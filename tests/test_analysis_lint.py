@@ -826,3 +826,94 @@ def test_rlt504_suppressible():
         "    for t in toks:\n"
         "        chan.send(t)  # rlt: disable=RLT504\n")
     assert "RLT504" not in rules_of(fs)
+
+
+# ---- RLT309 redundant prefix prefill (serve/kv_cache.py PrefixCache, -------
+# ---- docs/SERVING.md "prefix cache") ---------------------------------------
+
+
+def test_rlt309_constant_prefix_submit_fires():
+    # the anti-pattern the prefix cache exists to prevent: every
+    # request re-prefills the same system prompt
+    fs = lint(
+        "import numpy as np\n"
+        "def fleet(sched, tails, sys_prompt):\n"
+        "    for i, tail in enumerate(tails):\n"
+        "        sched.submit(Request(rid=str(i),\n"
+        "            prompt=np.concatenate([sys_prompt, tail])))\n")
+    assert "RLT309" in rules_of(fs)
+
+
+def test_rlt309_assigned_prompt_and_addition_forms_fire():
+    # the prompt built on its own line, and the list-concatenation
+    # spelling — both still a constant prefix per request
+    fs = lint(
+        "import numpy as np\n"
+        "def fleet(sched, tails, sys_prompt):\n"
+        "    for tail in tails:\n"
+        "        prompt = np.concatenate([sys_prompt, tail])\n"
+        "        sched.submit(Request(rid='x', prompt=prompt))\n")
+    assert "RLT309" in rules_of(fs)
+    fs = lint(
+        "def fleet(driver, tails, sys_tokens):\n"
+        "    for tail in tails:\n"
+        "        driver.submit(Request(rid='x',\n"
+        "                              prompt=sys_tokens + tail))\n")
+    assert "RLT309" in rules_of(fs)
+
+
+def test_rlt309_quiet_when_prefix_cache_armed():
+    # prefix_cache=True anywhere in the file sanctions the loop — the
+    # cache prefills the common prefix once, the loop is intended usage
+    fs = lint(
+        "import numpy as np\n"
+        "def fleet(engine, tails, sys_prompt):\n"
+        "    sched = Scheduler(engine, prefix_cache=True)\n"
+        "    for i, tail in enumerate(tails):\n"
+        "        sched.submit(Request(rid=str(i),\n"
+        "            prompt=np.concatenate([sys_prompt, tail])))\n")
+    assert "RLT309" not in rules_of(fs)
+
+
+def test_rlt309_quiet_on_variant_prefix_and_plain_prompts():
+    # a prefix that changes per iteration shares nothing; a prompt
+    # submitted as-is concatenates nothing
+    fs = lint(
+        "import numpy as np\n"
+        "def fleet(sched, pairs):\n"
+        "    for head, tail in pairs:\n"
+        "        sched.submit(Request(rid='x',\n"
+        "            prompt=np.concatenate([head, tail])))\n")
+    assert "RLT309" not in rules_of(fs)
+    fs = lint(
+        "def fleet(sched, prompts):\n"
+        "    for i, p in enumerate(prompts):\n"
+        "        sched.submit(Request(rid=str(i), prompt=p))\n")
+    assert "RLT309" not in rules_of(fs)
+
+
+def test_rlt309_quiet_in_traced_code():
+    # inside jit there is no scheduler to submit to — same scope rule
+    # as the other serve-loop lints
+    fs = lint(
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def step(sched_like, tails, sys_prompt):\n"
+        "    for tail in tails:\n"
+        "        sched_like.submit(Request(rid='x',\n"
+        "            prompt=jnp.concatenate([sys_prompt, tail])))\n"
+        "    return tails\n")
+    assert "RLT309" not in rules_of(fs)
+
+
+def test_rlt309_suppressible():
+    fs = lint(
+        "import numpy as np\n"
+        "def fleet(sched, tails, sys_prompt):\n"
+        "    for tail in tails:\n"
+        "        sched.submit(  # rlt: disable=RLT309\n"
+        "            Request(rid='x',\n"
+        "                    prompt=np.concatenate([sys_prompt, "
+        "tail])))\n")
+    assert "RLT309" not in rules_of(fs)

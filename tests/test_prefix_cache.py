@@ -1,0 +1,319 @@
+"""Prefix-sharing copy-on-write block tables (docs/SERVING.md "prefix
+sharing").
+
+Three layers, cheapest first:
+
+- `BlockAllocator` refcount semantics: sharing maps one physical block
+  into many tables, so the free/decref bookkeeping must refuse the bugs
+  that silently corrupt a *different* request's cache.
+- `PrefixCache` unit behavior: digest chains, LRU match/register,
+  refcount-1-only eviction.
+- Scheduler integration on the real engine: an 8-stream fleet sharing a
+  system prompt stays BITWISE equal to per-stream `generate()` while
+  prefilling the shared prefix exactly once (strictly fewer prefill
+  tokens than the unshared run), the slide-back fork path, and
+  preemption decref-not-free with bitwise replay off the still-cached
+  chain.
+"""
+import jax
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.models.llama import generate
+from ray_lightning_tpu.serve.engine import DecodeEngine, EngineConfig
+from ray_lightning_tpu.serve.kv_cache import (BlockAllocator, PagedPoolSpec,
+                                              PrefixCache,
+                                              prefix_block_hashes)
+from ray_lightning_tpu.serve.scheduler import Request, Scheduler
+
+
+def _drain(sched, reqs):
+    out = {}
+    for r in reqs:
+        sched.submit(r)
+    while sched.busy():
+        for comp in sched.tick():
+            out[comp.rid] = comp
+    return out
+
+
+def _shared_prompts(cfg, n=8, prefix_len=9):
+    """n prompts sharing a ``prefix_len``-token system prompt with
+    ragged per-stream tails."""
+    sys_prompt = np.asarray(
+        jax.random.randint(jax.random.key(5), (prefix_len,), 0,
+                           cfg.vocab_size), np.int32)
+    prompts = []
+    for i in range(n):
+        tail = np.asarray(
+            jax.random.randint(jax.random.key(50 + i), (2 + (i % 3),), 0,
+                               cfg.vocab_size), np.int32)
+        prompts.append(np.concatenate([sys_prompt, tail]))
+    return prompts
+
+
+# ---------------------------------------------------------------------------
+# BlockAllocator refcounts
+# ---------------------------------------------------------------------------
+
+
+def test_allocator_refcount_lifecycle():
+    spec = PagedPoolSpec(n_blocks=6, block_size=4, blocks_per_slot=4)
+    alloc = BlockAllocator(spec)
+    ids = alloc.alloc(2)
+    assert ids is not None and len(ids) == 2
+    assert all(alloc.refcount(b) == 1 for b in ids)
+    alloc.incref(ids)
+    assert all(alloc.refcount(b) == 2 for b in ids)
+    # first decref drops a sharer, frees nothing
+    assert alloc.decref(ids) == []
+    assert alloc.free_blocks == 3
+    # last reference dies -> both blocks return to the free list
+    assert sorted(alloc.decref(ids)) == sorted(ids)
+    assert alloc.free_blocks == 5
+    assert all(alloc.refcount(b) == 0 for b in ids)
+
+
+def test_allocator_double_decref_refused():
+    spec = PagedPoolSpec(n_blocks=4, block_size=4, blocks_per_slot=2)
+    alloc = BlockAllocator(spec)
+    (b,) = alloc.alloc(1)
+    alloc.decref([b])
+    with pytest.raises(ValueError, match="double free"):
+        alloc.decref([b])
+    # `free` is a decref alias — the refusal covers historical sites
+    with pytest.raises(ValueError, match="double free"):
+        alloc.free([b])
+
+
+def test_allocator_incref_of_unallocated_refused():
+    spec = PagedPoolSpec(n_blocks=4, block_size=4, blocks_per_slot=2)
+    alloc = BlockAllocator(spec)
+    with pytest.raises(ValueError, match="incref of unallocated"):
+        alloc.incref([2])
+    with pytest.raises(ValueError, match="invalid block"):
+        alloc.decref([0])  # scratch is never allocatable
+
+
+# ---------------------------------------------------------------------------
+# Digest chains + PrefixCache
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_block_hashes_are_cumulative():
+    a = np.arange(12, dtype=np.int32)
+    b = np.arange(12, dtype=np.int32)
+    b[0] = 99  # differ in the FIRST token only
+    ha, hb = prefix_block_hashes(a, 4), prefix_block_hashes(b, 4)
+    assert len(ha) == 3  # full blocks only
+    # equal prefixes -> equal digests; an early divergence poisons
+    # EVERY later digest (a block is only shareable with its prefix)
+    assert all(x != y for x, y in zip(ha, hb))
+    assert prefix_block_hashes(a[:11], 4) == ha[:2]
+    assert prefix_block_hashes(a, 4) == ha  # deterministic
+
+
+def test_prefix_cache_match_register_and_refs():
+    spec = PagedPoolSpec(n_blocks=8, block_size=4, blocks_per_slot=4)
+    alloc = BlockAllocator(spec)
+    cache = PrefixCache(alloc)
+    toks = np.arange(12, dtype=np.int32)
+    hashes = prefix_block_hashes(toks, 4)
+    blocks = alloc.alloc(3)
+    cache.register(hashes, blocks)
+    # the cache holds exactly ONE reference per block on top of the
+    # slot's own
+    assert all(alloc.refcount(b) == 2 for b in blocks)
+    assert cache.match(hashes) == blocks
+    assert cache.match(hashes, max_blocks=2) == blocks[:2]
+    # diverging second block truncates the match at the chain break
+    other = toks.copy()
+    other[5] = 77
+    assert cache.match(prefix_block_hashes(other, 4)) == blocks[:1]
+    # re-registering under a different block keeps the first publication
+    dup = alloc.alloc(3)
+    cache.register(hashes, dup)
+    assert cache.match(hashes) == blocks
+    assert all(alloc.refcount(b) == 1 for b in dup)
+
+
+def test_prefix_cache_evicts_only_sole_holder_lru():
+    spec = PagedPoolSpec(n_blocks=8, block_size=4, blocks_per_slot=4)
+    alloc = BlockAllocator(spec)
+    cache = PrefixCache(alloc)
+    h_a = prefix_block_hashes(np.arange(8, dtype=np.int32), 4)
+    h_b = prefix_block_hashes(np.arange(100, 108, dtype=np.int32), 4)
+    blocks_a, blocks_b = alloc.alloc(2), alloc.alloc(2)
+    cache.register(h_a, blocks_a)
+    cache.register(h_b, blocks_b)
+    # release the slots' own refs: the cache is now the sole holder
+    alloc.decref(blocks_a)
+    alloc.decref(blocks_b)
+    # ...except a live slot re-attaches to chain A
+    alloc.incref(blocks_a)
+    assert cache.evict(4) == 2  # only chain B (refcount 1) is evictable
+    assert cache.match(h_b) == []
+    assert cache.match(h_a) == blocks_a  # shared chain survived
+    assert alloc.free_blocks == 3 + 2
+
+
+def test_scheduler_evicts_prefix_cache_when_pool_dry(tiny_llama_f32):
+    # pool sized so the second DISTINCT prompt cannot be admitted
+    # without reclaiming the first prompt's cached (idle) chain
+    cfg, model, params, _ = tiny_llama_f32
+    ecfg = EngineConfig(capacity=1, block_size=4, blocks_per_slot=3,
+                        prefill_chunk=4)
+    eng = DecodeEngine(model, params, ecfg)
+    eng.warmup()
+    sched = Scheduler(eng, prefix_cache=True)
+    p1 = np.asarray(jax.random.randint(jax.random.key(8), (8,), 0,
+                                       cfg.vocab_size), np.int32)
+    p2 = np.asarray(jax.random.randint(jax.random.key(9), (8,), 0,
+                                       cfg.vocab_size), np.int32)
+    out = _drain(sched, [Request(rid="a", prompt=p1, max_new_tokens=2,
+                                 seed=1)])
+    assert len(sched.prefix) > 0 and sched.alloc.free_blocks < 2
+    out.update(_drain(sched, [Request(rid="b", prompt=p2,
+                                      max_new_tokens=2, seed=2)]))
+    for rid, prompt, seed in (("a", p1, 1), ("b", p2, 2)):
+        ref = np.asarray(generate(model, params, prompt[None], 2,
+                                  temperature=0.0, seed=seed))[0]
+        assert np.array_equal(ref, np.array(out[rid].tokens)), rid
+
+
+# ---------------------------------------------------------------------------
+# Scheduler integration on the real engine
+# ---------------------------------------------------------------------------
+
+
+def test_shared_prefix_fleet_bitwise_and_prefills_once(tiny_llama_f32):
+    cfg, model, params, _ = tiny_llama_f32
+    prompts = _shared_prompts(cfg)
+    ecfg = EngineConfig(capacity=4, block_size=4, blocks_per_slot=8,
+                        prefill_chunk=4)
+
+    def fleet(prefix_cache):
+        eng = DecodeEngine(model, params, ecfg)
+        eng.warmup()
+        sched = Scheduler(eng, prefix_cache=prefix_cache)
+        reqs = [Request(rid=f"r{i}", prompt=p, max_new_tokens=6,
+                        seed=3 + i) for i, p in enumerate(prompts)]
+        out = _drain(sched, reqs)
+        return eng, sched, reqs, out
+
+    eng, sched, reqs, out = fleet(prefix_cache=True)
+    for i, r in enumerate(reqs):
+        ref = np.asarray(generate(model, params, prompts[i][None],
+                                  r.max_new_tokens, temperature=0.0,
+                                  seed=r.seed))[0]
+        assert np.array_equal(ref, np.array(out[r.rid].tokens,
+                                            np.int32)), i
+    assert eng.compile_count == 1  # sharing never re-traces the step
+    assert sched.shared_block_fraction > 0.0
+
+    _, unshared, _, _ = fleet(prefix_cache=False)
+    assert unshared.shared_block_fraction == 0.0
+    # the prefill-once pin: the common prefix is computed for ONE
+    # stream only, so issued prefill tokens drop strictly
+    assert sched.prefill_tokens_issued < unshared.prefill_tokens_issued
+
+
+def test_fork_on_slide_back_window_stays_bitwise(tiny_llama_f32):
+    # blocks_per_slot=4 -> max_slot_len=16, chunk=8, P=4: a 15-token
+    # prompt matches 3 shared blocks (12 tokens) but the final chunk's
+    # slide-back window [8, 16) overlaps shared block 2 -> the slot
+    # must FORK that block before the in-place rewrite
+    cfg, model, params, _ = tiny_llama_f32
+    ecfg = EngineConfig(capacity=2, block_size=4, blocks_per_slot=4,
+                        prefill_chunk=8)
+    eng = DecodeEngine(model, params, ecfg)
+    eng.warmup()
+    sched = Scheduler(eng, prefix_cache=True)
+    prompt = np.asarray(jax.random.randint(jax.random.key(7), (15,), 0,
+                                           cfg.vocab_size), np.int32)
+    reqs = [Request(rid=f"f{i}", prompt=prompt, max_new_tokens=1,
+                    seed=11 + i) for i in range(3)]
+    out = _drain(sched, reqs)
+    for r in reqs:
+        ref = np.asarray(generate(model, params, prompt[None], 1,
+                                  temperature=0.0, seed=r.seed))[0]
+        assert np.array_equal(ref, np.array(out[r.rid].tokens)), r.rid
+    assert sched.shared_block_fraction > 0.0
+    assert eng.compile_count == 1
+
+
+def test_preempted_shared_blocks_decref_then_replay_reattaches(
+        tiny_llama_f32):
+    cfg, model, params, _ = tiny_llama_f32
+    prompts = _shared_prompts(cfg, n=2)
+    ecfg = EngineConfig(capacity=2, block_size=4, blocks_per_slot=8,
+                        prefill_chunk=4)
+    eng = DecodeEngine(model, params, ecfg)
+    eng.warmup()
+    sched = Scheduler(eng, prefix_cache=True)
+    out = _drain(sched, [Request(rid="seed", prompt=prompts[0],
+                                 max_new_tokens=4, seed=3)])
+    cached = len(sched.prefix)
+    assert cached > 0
+    chain_hashes = prefix_block_hashes(prompts[0], ecfg.block_size)
+    chain = sched.prefix.match(chain_hashes, max_blocks=cached)
+    free_before = sched.alloc.free_blocks
+    # admit a sharer of the cached chain, then yank it mid-flight: the
+    # eviction must DECREF its shared blocks (the cache's reference
+    # keeps the chain alive), never free them
+    sched.submit(Request(rid="v", prompt=prompts[1], max_new_tokens=4,
+                         seed=4))
+    sched.tick()
+    evicted = sched.evict_slotted()
+    assert [r.rid for r, _ in evicted] == ["v"]
+    # the seed chain survived the preemption, same physical blocks;
+    # the sharer's own full tail block may have been newly registered
+    # during its prefill tick (the cache retains those too)
+    assert sched.prefix.match(chain_hashes, max_blocks=cached) == chain
+    assert all(sched.alloc.refcount(b) == 1 for b in chain)
+    newly_cached = len(sched.prefix) - cached
+    assert sched.alloc.free_blocks == free_before - newly_cached
+    # bitwise replay re-attaches to the still-cached chain
+    for req, preempts in evicted:
+        sched.enqueue(req, preempts)
+    while sched.busy():
+        for comp in sched.tick():
+            out[comp.rid] = comp
+    ref = np.asarray(generate(model, params, prompts[1][None], 4,
+                              temperature=0.0, seed=4))[0]
+    assert np.array_equal(ref, np.array(out["v"].tokens))
+    assert out["v"].preempted == 1
+    assert sched.shared_block_fraction > 0.0
+
+
+def test_prefix_cache_requires_single_prefill_lane(tiny_llama_f32):
+    cfg, model, params, _ = tiny_llama_f32
+    ecfg = EngineConfig(capacity=4, block_size=4, blocks_per_slot=8,
+                        prefill_chunk=4, prefill_batch=2)
+    eng = DecodeEngine(model, params, ecfg)
+    with pytest.raises(ValueError, match="prefill_batch"):
+        Scheduler(eng, prefix_cache=True)
+
+
+# ---------------------------------------------------------------------------
+# Audit pricing
+# ---------------------------------------------------------------------------
+
+
+def test_shared_prefix_plan_prices_saved_pool_bytes(tiny_llama_f32):
+    from ray_lightning_tpu.serve.audit import shared_prefix_plan
+
+    cfg, _, _, _ = tiny_llama_f32
+    ecfg = EngineConfig(capacity=4, block_size=4, blocks_per_slot=8,
+                        prefill_chunk=4)
+    plan = shared_prefix_plan(cfg, ecfg, n_streams=8, prefix_tokens=16)
+    assert plan["shared_full_blocks"] == 16 // 4
+    # n-1 streams skip the prefix: bytes and prefill tokens both scale
+    assert plan["shared_pool_bytes_saved"] == (
+        7 * plan["shared_full_blocks"] * plan["block_bytes"])
+    assert plan["prefill_tokens_saved"] == 7 * 16
+    assert (plan["pool_bytes_without_sharing"]
+            - plan["pool_bytes_with_sharing"]
+            == plan["shared_pool_bytes_saved"])
+    with pytest.raises(ValueError, match="n_streams"):
+        shared_prefix_plan(cfg, ecfg, n_streams=0)

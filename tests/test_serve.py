@@ -403,8 +403,16 @@ def test_bench_serve_summary_static():
     assert set(s["serving"]["schema"]) == {
         "decode_tokens_per_s", "prefill_tokens_per_s",
         "ttft_cold_s", "ttft_warm_s", "ttft_p99_s", "slot_occupancy",
+        "shared_block_fraction", "accepted_tokens_per_step",
         "serving_attention_path", "serving_prefill_path",
         "serve_metrics", "scale_up_s", "autoscale"}
+    # the ISSUE 19 static pricing blocks ride every line
+    assert s["serving"]["prefix_plan"]["shared_pool_bytes_saved"] > 0
+    assert s["serving"]["prefix_plan"]["prefill_tokens_saved"] > 0
+    sp = s["serving"]["speculative_plan"]
+    assert sp["verify_step_flops"] == \
+        sp["k"] * sp["base_decode_flops_per_token"]
+    assert sp["expected_tokens_per_tick"] > 1.0
     # the TP=2 sharded-replica section (ISSUE 18): per-shard HBM halves
     # the replicated plan's params, and the decode collective schedule
     # carries the gate-ratcheted per-tick wire total

@@ -1,0 +1,199 @@
+"""Speculative decoding on the paged engine (docs/SERVING.md
+"speculative decoding").
+
+The contract is exact: greedy accept/reject makes a draft-armed engine
+TOKEN-IDENTICAL to plain greedy decode for every k — the draft only
+changes how many verified tokens land per tick, never which tokens.
+Covered here: token-identity at k=1/3/4 against an independent draft,
+the full-acceptance ceiling with the target drafting for itself
+(accepted_tokens_per_step == k), the one-compile pin, admission-time
+gates (greedy-only, k-1 slot headroom), construction gates, the audit
+cost model, and the driver's inline-only arming rules.
+"""
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.models.llama import Llama, generate
+from ray_lightning_tpu.serve.engine import (DecodeEngine, DraftConfig,
+                                            EngineConfig)
+from ray_lightning_tpu.serve.scheduler import (Request, Scheduler,
+                                               validate_request)
+
+
+def _drain(sched, reqs):
+    out = {}
+    for r in reqs:
+        sched.submit(r)
+    while sched.busy():
+        for comp in sched.tick():
+            out[comp.rid] = comp
+    return out
+
+
+def _prompts(cfg, n=6):
+    prompts = []
+    for i in range(n):
+        size = 9 + 2 + (i % 3)
+        prompts.append(np.asarray(
+            jax.random.randint(jax.random.key(60 + i), (size,), 0,
+                               cfg.vocab_size), np.int32))
+    return prompts
+
+
+@pytest.fixture(scope="module")
+def draft_llama(tiny_llama_f32):
+    """An INDEPENDENT draft: same tiny architecture, different init key
+    — so acceptance is partial and the reject path actually runs."""
+    cfg, _, _, tokens = tiny_llama_f32
+    draft = Llama(cfg)
+    draft_params = jax.jit(draft.init)(jax.random.key(2),
+                                       tokens)["params"]
+    return draft, draft_params
+
+
+@pytest.mark.parametrize("k", [1, 3, 4])
+def test_speculative_greedy_token_identical(tiny_llama_f32, draft_llama,
+                                            k):
+    cfg, model, params, _ = tiny_llama_f32
+    draft, draft_params = draft_llama
+    prompts = _prompts(cfg)
+    ecfg = EngineConfig(capacity=4, block_size=4, blocks_per_slot=8,
+                        prefill_chunk=4, draft=DraftConfig(k=k))
+    eng = DecodeEngine(model, params, ecfg, draft_model=draft,
+                       draft_params=draft_params)
+    eng.warmup()
+    sched = Scheduler(eng)
+    reqs = [Request(rid=f"s{i}", prompt=p, max_new_tokens=6, seed=3 + i)
+            for i, p in enumerate(prompts)]
+    out = _drain(sched, reqs)
+    for i, r in enumerate(reqs):
+        ref = np.asarray(generate(model, params, prompts[i][None],
+                                  r.max_new_tokens, temperature=0.0,
+                                  seed=r.seed))[0]
+        got = np.array(out[r.rid].tokens, np.int32)
+        assert np.array_equal(ref, got), (k, i, ref, got)
+    # every tick emits the carried token at minimum; k=1 IS plain
+    # greedy (the chunk holds only t0), so the rate pins to exactly 1
+    rate = sched.accepted_tokens_per_step
+    assert rate >= 1.0
+    if k == 1:
+        assert rate == 1.0
+    assert eng.compile_count == 1  # verify chunk rides the ONE step
+
+
+def test_self_draft_reaches_full_acceptance(tiny_llama_f32):
+    # target drafting for itself agrees with every proposal: each
+    # decode slot-step emits the full k-token chunk
+    cfg, model, params, _ = tiny_llama_f32
+    prompt = _prompts(cfg, n=1)[0]
+    ecfg = EngineConfig(capacity=2, block_size=4, blocks_per_slot=8,
+                        prefill_chunk=4, draft=DraftConfig(k=4))
+    eng = DecodeEngine(model, params, ecfg, draft_model=model,
+                       draft_params=params)
+    eng.warmup()
+    sched = Scheduler(eng)
+    out = _drain(sched, [Request(rid="x0", prompt=prompt,
+                                 max_new_tokens=8, seed=9)])
+    ref = np.asarray(generate(model, params, prompt[None], 8,
+                              temperature=0.0, seed=9))[0]
+    assert np.array_equal(ref, np.array(out["x0"].tokens))
+    assert sched.accepted_tokens_per_step == 4.0
+    assert eng.compile_count == 1
+
+
+# ---------------------------------------------------------------------------
+# Gates
+# ---------------------------------------------------------------------------
+
+
+def test_validate_request_speculative_is_greedy_only():
+    ecfg = EngineConfig(capacity=2, block_size=4, blocks_per_slot=4,
+                        prefill_chunk=4, draft=DraftConfig(k=4))
+    prompt = np.arange(6, dtype=np.int32)
+    with pytest.raises(ValueError, match="greedy-only"):
+        validate_request(ecfg, ecfg.pool_spec,
+                         Request(rid="t", prompt=prompt,
+                                 max_new_tokens=4, seed=0,
+                                 temperature=0.7))
+    # the verify chunk writes k positions from the last decode pos:
+    # k-1 headroom must be charged against the slot span
+    fits_plain = Request(rid="h", prompt=prompt, max_new_tokens=10,
+                         seed=0)
+    validate_request(dataclasses.replace(ecfg, draft=None),
+                     ecfg.pool_spec, fits_plain)
+    with pytest.raises(ValueError, match="max_slot_len"):
+        validate_request(ecfg, ecfg.pool_spec, fits_plain)
+
+
+def test_engine_config_draft_gates():
+    with pytest.raises(ValueError, match="prefill_batch"):
+        EngineConfig(capacity=2, block_size=4, blocks_per_slot=4,
+                     prefill_chunk=4, prefill_batch=2,
+                     draft=DraftConfig(k=2))
+    with pytest.raises(ValueError, match="draft k"):
+        EngineConfig(capacity=2, block_size=4, blocks_per_slot=2,
+                     prefill_chunk=4, draft=DraftConfig(k=9))
+    with pytest.raises(ValueError, match="k must be >= 1"):
+        DraftConfig(k=0)
+    # dict form coerces (the driver's JSON config path)
+    ecfg = EngineConfig(capacity=2, block_size=4, blocks_per_slot=4,
+                        prefill_chunk=4, draft={"k": 3})
+    assert ecfg.draft == DraftConfig(k=3)
+
+
+def test_engine_requires_draft_weights(tiny_llama_f32):
+    cfg, model, params, _ = tiny_llama_f32
+    ecfg = EngineConfig(capacity=2, block_size=4, blocks_per_slot=4,
+                        prefill_chunk=4, draft=DraftConfig(k=2))
+    with pytest.raises(ValueError, match="draft model"):
+        DecodeEngine(model, params, ecfg)
+
+
+def test_driver_speculative_arming_gates(tiny_llama_f32):
+    from ray_lightning_tpu.serve.driver import (ReplicaGroupConfig,
+                                                ServeDriver)
+
+    cfg, _, params, _ = tiny_llama_f32
+    ecfg = EngineConfig(capacity=2, block_size=4, blocks_per_slot=4,
+                        prefill_chunk=4, draft=DraftConfig(k=2))
+    with pytest.raises(ValueError, match="inline-only"):
+        ReplicaGroupConfig(backend="process", engine=ecfg,
+                           draft_model_cfg=cfg)
+    with pytest.raises(ValueError, match="arm together"):
+        ReplicaGroupConfig(backend="inline", engine=ecfg)
+    good = ReplicaGroupConfig(backend="inline", engine=ecfg,
+                              draft_model_cfg=cfg)
+    with pytest.raises(ValueError, match="arm together"):
+        ServeDriver(cfg, params, good)  # draft_params missing
+
+
+# ---------------------------------------------------------------------------
+# Audit cost model
+# ---------------------------------------------------------------------------
+
+
+def test_speculative_plan_cost_model(tiny_llama_f32):
+    from ray_lightning_tpu.serve.audit import speculative_plan
+
+    cfg, _, _, _ = tiny_llama_f32
+    draft_cfg = dataclasses.replace(cfg, n_layers=max(
+        1, cfg.n_layers // 4))
+    ecfg = EngineConfig(capacity=2, block_size=4, blocks_per_slot=4,
+                        prefill_chunk=4, draft=DraftConfig(k=4))
+    plan = speculative_plan(cfg, draft_cfg, ecfg, accept_rate=0.5)
+    # the k-wide verify prices exactly k target decode steps of FLOPs
+    assert plan["verify_step_flops"] == (
+        plan["k"] * plan["base_decode_flops_per_token"])
+    # expected emission: the carried token plus accepted proposals
+    assert plan["expected_tokens_per_tick"] == 1 + 0.5 * (4 - 1)
+    assert plan["draft_params"] < plan["target_params"]
+    # memory-bound speedup only beats 1.0 when the extra draft reads
+    # cost less than the tokens they buy — the dict must price both
+    assert plan["hbm_read_bytes_per_tick_spec"] > \
+        plan["hbm_read_bytes_per_tick_base"]
+    assert plan["memory_bound_speedup_x"] > 0.0
+    with pytest.raises(ValueError, match="accept_rate"):
+        speculative_plan(cfg, draft_cfg, ecfg, accept_rate=1.5)
