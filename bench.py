@@ -631,7 +631,26 @@ def _serve_summary() -> dict:
                        "accepted_tokens_per_step",
                        "serving_attention_path",
                        "serving_prefill_path", "serve_metrics",
-                       "scale_up_s", "autoscale"],
+                       "scale_up_s", "autoscale", "slo_attainment",
+                       "slo_attainment_latency_critical",
+                       "shed_fraction"],
+            # ISSUE 20: the traffic-class leg's measured fields
+            # (success lines only; bench_gate ratchets the
+            # latency-critical attainment and waives skips)
+            "traffic_schema": {
+                "slo_attainment": "per class {ttft_p95_s, target_s, "
+                                  "attainment} from a mixed-class "
+                                  "burst with the SLO machinery "
+                                  "armed (docs/SERVING.md 'traffic "
+                                  "& SLO classes')",
+                "slo_attainment_latency_critical":
+                    "fraction of latency-critical completions whose "
+                    "TTFT met the class target — bench_gate ratchets "
+                    "it (may only grow)",
+                "shed_fraction": "typed best-effort sheds / submitted "
+                                 "requests in that burst — explicit "
+                                 "degradation, never silence",
+            },
             "prefix_plan": prefix_plan,
             "speculative_plan": spec_plan,
             "autoscale_schema": {
@@ -751,6 +770,50 @@ def _measure_serving(tiny: bool | None = None,
         pf_sched.tick()
     pf_wall = _time.perf_counter() - t0
     pf_tokens = pf_reg.counters().get("prefill_tokens", 0)
+    # per-class SLO leg (ISSUE 20): a mixed-class burst on the SAME
+    # warm engine with the SLO machinery armed — the best-effort
+    # admission budget forces typed shed records while the paying
+    # classes complete; the latency-critical attainment fraction is
+    # the number bench_gate ratchets (may only grow toward 1.0)
+    from ray_lightning_tpu.serve.scheduler import ClassSLO, SLOConfig
+
+    slo = SLOConfig(classes={
+        "latency_critical": ClassSLO(ttft_p95_s=10.0, tpot_p95_s=5.0),
+        "standard": ClassSLO(ttft_p95_s=30.0, tpot_p95_s=10.0),
+        "best_effort": ClassSLO(ttft_p95_s=60.0, tpot_p95_s=20.0,
+                                queue_budget=1),
+    })
+    slo_reg = MetricsRegistry()
+    engine.metrics = slo_reg
+    slo_sched = Scheduler(engine, metrics=slo_reg, slo=slo)
+    slo_classes = ("latency_critical", "standard", "best_effort")
+    for i in range(n_requests):
+        slo_sched.submit(Request(rid=f"s{i}", prompt=prompt[0],
+                                 max_new_tokens=max_new, seed=200 + i,
+                                 priority=slo_classes[i % 3]))
+    shed_recs = slo_sched.take_sheds()   # enqueue-budget sheds
+    while slo_sched.busy():
+        slo_sched.tick()
+        shed_recs.extend(slo_sched.take_sheds())
+    attain = {}
+    lc_frac = None
+    for cls in slo_classes:
+        spec = slo.classes[cls]
+        ttfts = sorted(c.ttft_s for c in slo_sched.completions
+                       if c.priority == cls)
+        if not ttfts:
+            continue
+        frac = sum(1 for t in ttfts if t <= spec.ttft_p95_s) \
+            / len(ttfts)
+        attain[cls] = {
+            "ttft_p95_s": round(ttfts[min(
+                len(ttfts) - 1,
+                max(0, -(-95 * len(ttfts) // 100) - 1))], 4),
+            "target_s": spec.ttft_p95_s,
+            "attainment": round(frac, 4),
+        }
+        if cls == "latency_critical":
+            lc_frac = round(frac, 4)
     engine.metrics = reg
     # the serve_metrics rollup: queue-depth stats from the per-tick
     # ring, event counters, and the warm TTFT p99 from the mergeable
@@ -780,6 +843,11 @@ def _measure_serving(tiny: bool | None = None,
         "shared_block_fraction": round(sched.shared_block_fraction, 4),
         "accepted_tokens_per_step": round(
             sched.accepted_tokens_per_step, 4),
+        # traffic-class leg (ISSUE 20): per-class attainment + the
+        # typed-shed fraction from the mixed-class burst above
+        "slo_attainment": attain,
+        "slo_attainment_latency_critical": lc_frac,
+        "shed_fraction": round(len(shed_recs) / n_requests, 4),
         "serving_compile_count": engine.compile_count,
         # which attention each lane actually exercised — a
         # decode/prefill tok/s number is only comparable to priors on

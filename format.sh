@@ -213,6 +213,19 @@ JAX_PLATFORMS=cpu python -m ray_lightning_tpu serve --smoke > /dev/null
 # with a structured reason instead of routing onto a stopping replica.
 JAX_PLATFORMS=cpu python -m ray_lightning_tpu autoscale --smoke > /dev/null
 
+# loadgen gate (docs/SERVING.md "traffic & SLO classes"): the seeded
+# trace format must serialize byte-deterministically (same seed ->
+# identical bytes, round-trip stable, wrong version refused); a bursty
+# mixed-class trace replayed twice through the REAL driver must
+# complete bitwise-identically with IDENTICAL per-class accounting and
+# shed sets — best-effort sheds as typed records with retry-after
+# hints while latency_critical stays un-shed, holds its TTFT target,
+# and preempts lower-class slots; WatchEngine fires exactly one
+# shed_best_effort incident; a process-backend leg with a zero
+# best-effort queue budget must shed exactly the best-effort arrivals
+# and stream the survivors bitwise; churn compiles the step ONCE.
+JAX_PLATFORMS=cpu python -m ray_lightning_tpu loadgen --smoke > /dev/null
+
 # elastic gate (docs/ELASTIC.md): an 8-device fsdp=8 CPU-SPMD
 # checkpoint must reshard-restore onto a 4-device fsdp=4 mesh with
 # every param/opt-state leaf BITWISE-equal to the source, and training

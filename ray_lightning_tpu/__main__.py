@@ -77,6 +77,20 @@ all-draining submit deferral):
     python -m ray_lightning_tpu autoscale
     python -m ray_lightning_tpu autoscale --smoke
 
+``loadgen`` runs the trace-driven load harness (loadgen/,
+docs/SERVING.md "traffic & SLO classes"): seeded Poisson/bursty-MMPP
+workload traces with heavy-tailed lengths and a traffic-class mix,
+generated or recorded as versioned JSONL and replayed bitwise against
+the real serving stack with priority/SLO-aware scheduling armed.
+``--smoke`` is the format.sh gate (byte-deterministic traces, a
+bursty mixed-class replay that sheds best-effort with typed records
+while latency-critical meets its TTFT SLO, a class-scoped incident,
+zero silent drops, compile count pinned at 1 on both backends):
+
+    python -m ray_lightning_tpu loadgen --out trace.jsonl --seed 7
+    python -m ray_lightning_tpu loadgen --trace trace.jsonl
+    python -m ray_lightning_tpu loadgen --smoke
+
 ``report`` / ``monitor`` read the telemetry a run left behind
 (telemetry/, docs/OBSERVABILITY.md): the goodput classification of
 supervised wall time, per-rank span timelines, and — with
@@ -653,6 +667,9 @@ def main(argv=None) -> int:
     from ray_lightning_tpu.elastic.cli import (
         add_elastic_parser, run_elastic,
     )
+    from ray_lightning_tpu.loadgen.cli import (
+        add_loadgen_parser, run_loadgen,
+    )
     from ray_lightning_tpu.pipeline.cli import add_perf_parser, run_perf
     from ray_lightning_tpu.resilience.cli import (
         add_supervise_parser, run_supervise,
@@ -679,6 +696,7 @@ def main(argv=None) -> int:
     add_watch_parser(sub)
     add_elastic_parser(sub)
     add_autoscale_parser(sub)
+    add_loadgen_parser(sub)
     args = p.parse_args(argv)
     if args.cmd == "plan":
         return run_plan(args)
@@ -704,6 +722,8 @@ def main(argv=None) -> int:
         return run_elastic(args)
     if args.cmd == "autoscale":
         return run_autoscale(args)
+    if args.cmd == "loadgen":
+        return run_loadgen(args)
     info = collect(probe=args.probe)
     if args.as_json:
         print(json.dumps(info))

@@ -214,6 +214,17 @@ RULES: Dict[str, Rule] = {r.id: r for r in (
          "tick's emissions into ONE side-channel item and ack ONE "
          "highest-seq per poll batch (serve/channel.py, "
          "docs/SERVING.md 'the request channel')"),
+    Rule("RLT505", "silent-request-drop", "error",
+         "serving code makes a request disappear without a typed "
+         "record: a broad except whose body only passes wrapped "
+         "around a submit()/enqueue() call, or take_sheds() drained "
+         "as a bare statement (/ a last_sheds/last_preemptions "
+         "buffer cleared unread) — the stream never gets a terminal "
+         "status, the client retries blind, and the loss is "
+         "invisible to watch/metrics. The graceful-overload contract "
+         "is EXPLICIT degradation: every rejected rid ends with a "
+         "reason + capped-exponential retry-after hint "
+         "(docs/SERVING.md 'traffic & SLO classes')"),
     # RLT6xx — elasticity anti-patterns (docs/ELASTIC.md): code that
     # pins a job to one world size for life.
     Rule("RLT601", "pinned-world-size", "warning",

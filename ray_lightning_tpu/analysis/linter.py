@@ -1747,6 +1747,141 @@ class _PrefixPrefillLint:
         walk(tree.body, None)
 
 
+# ---- RLT505: silent request drop ------------------------------------------
+
+#: serving submission verbs — one request enters the system per call
+_RLT505_SUBMIT_VERBS = {"submit", "enqueue"}
+#: drains whose return value IS the typed record set — discarding it
+#: discards the only evidence the request was rejected
+_RLT505_DRAINS = {"take_sheds"}
+#: record buffers a consumer may clear only after reading
+_RLT505_BUFFERS = {"last_sheds", "last_preemptions"}
+
+
+class _SilentDropLint:
+    """RLT505 silent-request-drop (docs/SERVING.md "traffic & SLO
+    classes"): serving code that makes a request disappear without a
+    typed record. Two shapes:
+
+    * a broad ``except``/``except Exception`` whose body only
+      ``pass``/``continue``s wrapped around a `submit()`/`enqueue()`
+      call — the request vanishes with no terminal status, no shed
+      record, no counter;
+    * `take_sheds()` called as a bare expression statement (or a
+      ``last_sheds``/``last_preemptions`` buffer ``.clear()``ed) —
+      the scheduler produced typed shed/preemption records and the
+      caller threw them away, so the stream never gets its terminal
+      meta and the client retries blind.
+
+    The graceful-overload contract is explicit degradation: every
+    rejected rid ends with a reason + retry-after hint. A consumer
+    that intentionally discards (e.g. a lockstep follower whose
+    LEADER owns emission) sanctions the line with
+    ``# rlt: disable=RLT505``."""
+
+    def __init__(self, lint: _FileLint):
+        self.lint = lint
+
+    @staticmethod
+    def _swallows(handler: ast.ExceptHandler) -> bool:
+        """Broad handler whose body only pass/continue/...-es."""
+        t = handler.type
+        broad = t is None or (
+            isinstance(t, (ast.Name, ast.Attribute))
+            and (_dotted(t) or "").split(".")[-1]
+            in ("Exception", "BaseException"))
+        if not broad:
+            return False
+        for stmt in handler.body:
+            if isinstance(stmt, (ast.Pass, ast.Continue)):
+                continue
+            if (isinstance(stmt, ast.Expr)
+                    and isinstance(stmt.value, ast.Constant)):
+                continue
+            return False
+        return True
+
+    def _lint_try(self, node: ast.Try, symbol: Optional[str]) -> None:
+        submits = [
+            sub for stmt in node.body for sub in ast.walk(stmt)
+            if isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr in _RLT505_SUBMIT_VERBS]
+        if not submits:
+            return
+        for handler in node.handlers:
+            if not self._swallows(handler):
+                continue
+            call = submits[0]
+            recv = (_dotted(call.func.value) or "").split(".")[-1]
+            self.lint.add(
+                "RLT505",
+                f"a broad except around {recv}.{call.func.attr}() "
+                "swallows the failure with a bare pass — the request "
+                "vanishes with no terminal status, no typed shed "
+                "record, no counter: the client retries blind and "
+                "the loss is invisible to watch/metrics. Record a "
+                "terminal outcome (or re-raise); rejection must be "
+                "EXPLICIT — a typed record with a retry-after hint "
+                "(docs/SERVING.md 'traffic & SLO classes')",
+                handler, symbol)
+
+    def _lint_expr(self, node: ast.Expr,
+                   symbol: Optional[str]) -> None:
+        call = node.value
+        if not (isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)):
+            return
+        verb = call.func.attr
+        if verb in _RLT505_DRAINS:
+            recv = (_dotted(call.func.value) or "").split(".")[-1]
+            self.lint.add(
+                "RLT505",
+                f"{recv}.{verb}() drained as a bare statement — the "
+                "typed shed records (rid, reason, retry_after_s) are "
+                "produced and immediately discarded: every shed "
+                "stream loses its terminal status and the drop is "
+                "silent (docs/SERVING.md 'traffic & SLO classes'). "
+                "Turn each record into a terminal outcome on the "
+                "stream; an intentional discard (lockstep follower — "
+                "the leader owns emission) sanctions the line with "
+                "# rlt: disable=RLT505", node, symbol)
+            return
+        if (verb == "clear" and isinstance(call.func.value,
+                                           ast.Attribute)
+                and call.func.value.attr in _RLT505_BUFFERS):
+            self.lint.add(
+                "RLT505",
+                f"{call.func.value.attr}.clear() wipes the "
+                "scheduler's typed record buffer without reading it "
+                "— shed/preemption evidence is destroyed before any "
+                "consumer could turn it into terminal stream status "
+                "(docs/SERVING.md 'traffic & SLO classes')",
+                node, symbol)
+
+    def run(self, tree: ast.Module, funcs: List["_Func"]) -> None:
+        traced_nodes = {id(fn.node) for fn in funcs if fn.traced}
+
+        def walk(stmts, symbol):
+            for node in stmts:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    # traced code has no scheduler to drop from —
+                    # same scope rule as the other serve-loop lints
+                    if id(node) not in traced_nodes:
+                        walk(node.body, node.name)
+                    continue
+                if isinstance(node, ast.Lambda):
+                    continue
+                if isinstance(node, ast.Try):
+                    self._lint_try(node, symbol)
+                elif isinstance(node, ast.Expr):
+                    self._lint_expr(node, symbol)
+                walk(list(ast.iter_child_nodes(node)), symbol)
+
+        walk(tree.body, None)
+
+
 def lint_source(source: str, filename: str = "<string>",
                 extra_axes: Sequence[str] = ()) -> List[Finding]:
     """Lint one file's source text. Never imports the target."""
@@ -1810,6 +1945,7 @@ def lint_source(source: str, filename: str = "<string>",
     _LedgerTailLint(lint).run(tree, coll)
     _ChannelChatterLint(lint).run(tree, coll.funcs)
     _PrefixPrefillLint(lint).run(tree, coll.funcs)
+    _SilentDropLint(lint).run(tree, coll.funcs)
     return lint.findings
 
 

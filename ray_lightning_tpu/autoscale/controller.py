@@ -117,7 +117,14 @@ def _signal_snapshot(signal: dict) -> dict:
             "queue_depth_max", "occupancy", "pressure", "total_slots",
             "blocks_free_fraction", "replicas_reporting",
             "replicas_retired", "window_ticks")
-    return {k: signal[k] for k in keys if k in signal}
+    snap = {k: signal[k] for k in keys if k in signal}
+    # per-traffic-class fields (pressure_<class> / queue_depth_now_<cls>
+    # / sheds_<class>) are flat and policy-readable — keep them in the
+    # ledger so a class-targeted decision stays auditable
+    snap.update({k: v for k, v in signal.items()
+                 if k.startswith(("pressure_", "queue_depth_now_",
+                                  "sheds_"))})
+    return snap
 
 
 class AutoscaleController:

@@ -75,6 +75,14 @@ class PolicyConfig:
     down_cooldown_s: float = 60.0
     #: replicas added/removed per decision
     max_step: int = 1
+    #: traffic class whose flat per-class signal fields
+    #: (``pressure_<class>`` / ``queue_depth_now_<class>``, emitted
+    #: when the scheduler runs with an `SLOConfig`) drive the band
+    #: instead of the pooled signal — e.g. "latency_critical" reacts
+    #: to paying-class pressure while a shed best_effort backlog
+    #: queues. Falls back to the pooled fields when the signal carries
+    #: no per-class data (priority-off run). None = pooled (historical)
+    pressure_class: Optional[str] = None
 
     def __post_init__(self):
         if not 1 <= self.min_replicas <= self.max_replicas:
@@ -140,14 +148,22 @@ class Decision:
                 "clamps": list(self.clamps)}
 
 
-def _pressure(signal: dict) -> Tuple[float, float, float]:
+def _pressure(signal: dict,
+              pressure_class: Optional[str] = None
+              ) -> Tuple[float, float, float]:
     """(pressure, queue_depth_now, occupancy) with honest fallbacks: a
     None pressure means no slots reported — queued demand with zero
     slots is INFINITE pressure, an empty queue with zero slots is
-    zero."""
-    qd_now = float(signal.get("queue_depth_now") or 0.0)
+    zero. ``pressure_class`` narrows pressure/queue-depth to that
+    traffic class's flat fields when the signal carries them."""
+    p_key, qd_key = "pressure", "queue_depth_now"
+    if (pressure_class is not None
+            and f"pressure_{pressure_class}" in signal):
+        p_key = f"pressure_{pressure_class}"
+        qd_key = f"queue_depth_now_{pressure_class}"
+    qd_now = float(signal.get(qd_key) or 0.0)
     occ = float(signal.get("occupancy") or 0.0)
-    p = signal.get("pressure")
+    p = signal.get(p_key)
     if p is None:
         p = math.inf if qd_now > 0 else 0.0
     return float(p), qd_now, occ
@@ -197,7 +213,7 @@ def decide(cfg: PolicyConfig, state: PolicyState, signal: Optional[dict],
         return Decision(HOLD, n, 0,
                         "no load signal (metrics not flushed yet, or "
                         "nothing served)", ("no_signal",))
-    p, qd_now, occ = _pressure(signal)
+    p, qd_now, occ = _pressure(signal, cfg.pressure_class)
 
     if p >= cfg.high_pressure:
         state.high_streak += 1

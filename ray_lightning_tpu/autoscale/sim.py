@@ -1,16 +1,18 @@
-"""Deterministic scripted-load harness: the control loop on a virtual
-clock.
+"""Deterministic scripted-load harness — a thin back-compat shim over
+`ray_lightning_tpu.loadgen` (the trace-driven load harness that
+generalized this module; docs/SERVING.md "traffic & SLO classes").
 
-Wall-clock autoscale tests flake by construction — pressure depends on
-when the poll landed relative to the flush cadence. This harness makes
-the whole loop a pure function of the script: the DRIVER TICK COUNTER
-is the clock (1 tick = 1 virtual second for the policy's cooldown
-arithmetic), arrivals fire at scripted ticks, the controller polls
-every ``poll_every_ticks`` ticks, and the load signal is read from the
-same flushed metrics files production reads — so the smoke/test
-exercises the real signal path, the real policy, and the real
-`ServeDriver` seams with zero sleeps and zero wall-clock sensitivity
-(tests/test_autoscale.py, ``autoscale --smoke``).
+The virtual-clock drive loop now lives in `loadgen.runner.run_trace`:
+the DRIVER TICK COUNTER is the clock (1 tick = 1 virtual second for
+the policy's cooldown arithmetic), arrivals fire at scripted ticks,
+the controller polls every ``poll_every_ticks`` ticks, and the load
+signal is read from the same flushed metrics files production reads —
+so the smoke/test exercises the real signal path, the real policy,
+and the real `ServeDriver` seams with zero sleeps and zero wall-clock
+sensitivity (tests/test_autoscale.py, ``autoscale --smoke``).
+`ScriptedLoad` keeps its historical API and gains ``to_events()``, so
+any scripted schedule can be persisted as a versioned loadgen trace
+and replayed bitwise.
 """
 from __future__ import annotations
 
@@ -32,6 +34,13 @@ class ScriptedLoad:
     def last_arrival_tick(self) -> int:
         return max(self.arrivals) if self.arrivals else 0
 
+    def to_events(self) -> List:
+        """Lift the schedule into loadgen trace events — write them
+        with `loadgen.trace.write_trace` for a replayable artifact."""
+        from ray_lightning_tpu.loadgen.trace import events_from_arrivals
+
+        return events_from_arrivals(self.arrivals)
+
 
 def run_scripted(driver, controller, load: ScriptedLoad,
                  poll_every_ticks: int = 2,
@@ -40,23 +49,12 @@ def run_scripted(driver, controller, load: ScriptedLoad,
     session must be `start()`ed. Returns
     ``{"ticks", "drained_at", "entries"}`` where ``entries`` is every
     controller ledger entry in order."""
-    entries: List[dict] = []
-    drained_at: Optional[int] = None
-    last_arrival = load.last_arrival_tick()
-    tick = 0
-    while tick < max_ticks:
-        for req in load.arrivals.get(tick, ()):
-            driver.submit(req)
-        driver.tick()
-        if tick % poll_every_ticks == 0:
-            entries.append(controller.step(now=float(tick)))
-        if tick >= last_arrival and not driver.busy():
-            if drained_at is None:
-                drained_at = tick
-            if tick - drained_at >= load.idle_ticks_after_drain:
-                break
-        else:
-            drained_at = None
-        tick += 1
-    return {"ticks": tick, "drained_at": drained_at,
-            "entries": entries}
+    from ray_lightning_tpu.loadgen.runner import run_trace
+
+    out = run_trace(
+        driver, load.arrivals, controller=controller,
+        poll_every_ticks=poll_every_ticks,
+        idle_ticks_after_drain=load.idle_ticks_after_drain,
+        max_ticks=max_ticks)
+    return {"ticks": out["ticks"], "drained_at": out["drained_at"],
+            "entries": out["entries"]}

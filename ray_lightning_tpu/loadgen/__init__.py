@@ -1,0 +1,47 @@
+"""Trace-driven load harness (docs/SERVING.md "traffic & SLO
+classes"): a deterministic workload generator + versioned replayable
+trace format + virtual-clock runner that drive the REAL
+`ServeDriver`/`Scheduler`/autoscale stack on CPU.
+
+* `loadgen.trace` — the versioned JSONL trace format (record a live
+  run, replay it bitwise) and Request materialization;
+* `loadgen.generator` — seeded arrival processes (Poisson / 2-state
+  bursty MMPP) with heavy-tailed length distributions and a traffic-
+  class mix, every draw from one `np.random.Generator(PCG64(seed))`;
+* `loadgen.runner` — the virtual-clock drive loop (the driver tick
+  counter is the clock; `autoscale.sim.run_scripted` is a thin shim
+  over it);
+* `loadgen.cli` — ``python -m ray_lightning_tpu loadgen`` and the
+  ``--smoke`` format.sh gate.
+"""
+from ray_lightning_tpu.loadgen.generator import (  # noqa: F401
+    WorkloadConfig,
+    generate_events,
+)
+from ray_lightning_tpu.loadgen.runner import run_trace  # noqa: F401
+from ray_lightning_tpu.loadgen.trace import (  # noqa: F401
+    TRACE_VERSION,
+    TraceEvent,
+    TraceRecorder,
+    arrivals_by_tick,
+    dump_trace,
+    events_from_arrivals,
+    read_trace,
+    to_request,
+    write_trace,
+)
+
+__all__ = [
+    "TRACE_VERSION",
+    "TraceEvent",
+    "TraceRecorder",
+    "WorkloadConfig",
+    "arrivals_by_tick",
+    "dump_trace",
+    "events_from_arrivals",
+    "generate_events",
+    "read_trace",
+    "run_trace",
+    "to_request",
+    "write_trace",
+]

@@ -309,6 +309,7 @@ def request_to_wire(req) -> Dict[str, Any]:
         "seed": int(req.seed),
         "eos_id": None if req.eos_id is None else int(req.eos_id),
         "arrival": float(req.arrival),
+        "priority": req.priority,
     }
 
 
@@ -328,4 +329,6 @@ def request_from_wire(d: Dict[str, Any]):
         seed=int(d.get("seed", 0)),
         eos_id=d.get("eos_id"),
         arrival=float(d.get("arrival", 0.0)),
+        # absent on command logs written before traffic classes
+        priority=d.get("priority", "standard"),
     )

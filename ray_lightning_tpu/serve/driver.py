@@ -65,7 +65,9 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from ray_lightning_tpu.serve.engine import DecodeEngine, EngineConfig
-from ray_lightning_tpu.serve.scheduler import Completion, Request, Scheduler
+from ray_lightning_tpu.serve.scheduler import (
+    Completion, Request, Scheduler, SLOConfig,
+)
 from ray_lightning_tpu.analysis.lockwatch import san_lock
 from ray_lightning_tpu.utils import get_logger
 
@@ -153,6 +155,10 @@ class ReplicaGroupConfig:
     #: only (the process respawn path reloads ONE params .npz and the
     #: wire carries no draft weights)
     draft_model_cfg: Optional[Any] = None
+    #: traffic classes + graceful-overload policy
+    #: (scheduler.SLOConfig, docs/SERVING.md "traffic & SLO classes").
+    #: None keeps the historical single-class scheduler byte-identical
+    slo: Optional[SLOConfig] = None
 
     def __post_init__(self):
         if self.backend not in ("inline", "process"):
@@ -308,7 +314,8 @@ def _serve_loop(engine: DecodeEngine, reserve: str,
                 on_token=None, on_completion=None, on_preempt=None,
                 fault: Optional[dict] = None,
                 fault_dir: Optional[str] = None,
-                metrics_cfg: Optional[dict] = None):
+                metrics_cfg: Optional[dict] = None,
+                slo: Optional[SLOConfig] = None, on_shed=None):
     """Drain ``requests`` through one replica. ``on_token(rid, tok)``
     streams tokens as they are emitted; ``on_completion(comp)`` fires at
     retirement. ``fault={"kill_after_tokens": n}`` SIGKILLs this process
@@ -328,9 +335,18 @@ def _serve_loop(engine: DecodeEngine, reserve: str,
                                                16))
     engine.metrics = metrics
     sched = Scheduler(engine, reserve=reserve, metrics=metrics,
-                      flight=flight)
+                      flight=flight, slo=slo)
+
+    def drain_sheds():
+        # typed shed records are terminal statuses, never silence
+        # (RLT505): every record reaches the caller's stream
+        for rec in sched.take_sheds():
+            if on_shed is not None:
+                on_shed(rec)
+
     for req in requests:
         sched.submit(req)
+    drain_sheds()  # enqueue-time budget sheds fire before any tick
     emitted_total = 0
     kill_after = int((fault or {}).get("kill_after_tokens", 0))
     marker = (os.path.join(fault_dir, f"replica{replica}.killed")
@@ -356,6 +372,7 @@ def _serve_loop(engine: DecodeEngine, reserve: str,
                 on_completion(comp)
             if len(done) % FLUSH_EVERY_N_COMPLETIONS == 0:
                 recorder.flush()
+        drain_sheds()
         if (kill_after and emitted_total >= kill_after and marker
                 and not os.path.exists(marker)):
             # fire-once across respawns: the marker outlives this
@@ -388,7 +405,8 @@ def _replica_worker_main(model_cfg_kw: dict, params_path: str,
                          compile_cache_dir: Optional[str],
                          fault: Optional[dict],
                          fault_dir: Optional[str],
-                         metrics_cfg: Optional[dict] = None) -> dict:
+                         metrics_cfg: Optional[dict] = None,
+                         slo_kw: Optional[dict] = None) -> dict:
     """Runs inside the WorkerGroup worker process: rebuild the model,
     reload weights, warm the step (persistent compile cache when
     armed), announce live, then serve — streaming every token over the
@@ -429,14 +447,20 @@ def _replica_worker_main(model_cfg_kw: dict, params_path: str,
             "ttft_s": comp.ttft_s, "tpot_s": comp.tpot_s,
             "decode_s": comp.decode_s, "preempted": comp.preempted,
             "n_tokens": len(comp.tokens),
+            "priority": comp.priority,
         }))
+
+    def on_shed(rec):
+        session.put_queue(("shed", replica, rec["rid"], rec))
 
     done, sched = _serve_loop(engine, reserve, requests, replica,
                               run_dir=run_dir, on_token=on_token,
                               on_completion=on_completion,
                               on_preempt=on_preempt, fault=fault,
                               fault_dir=fault_dir,
-                              metrics_cfg=metrics_cfg)
+                              metrics_cfg=metrics_cfg,
+                              slo=SLOConfig.from_wire(slo_kw),
+                              on_shed=on_shed)
     return {"replica": replica, "completed": len(done),
             "steps": engine.steps, "warmup_s": warm_s,
             "compile_count": engine.compile_count,
@@ -453,6 +477,7 @@ def _replica_session_main(model_cfg_kw: dict, params_path: str,
                           fault_dir: Optional[str],
                           metrics_cfg: Optional[dict],
                           channel_epoch: int, tp: int,
+                          slo_kw: Optional[dict] = None,
                           rank: int = 0) -> dict:
     """One rank of a DYNAMIC-SESSION replica group (serve/channel.py).
 
@@ -514,7 +539,7 @@ def _replica_session_main(model_cfg_kw: dict, params_path: str,
                           persist_every=mc.get("flight_persist_every", 16))
     engine.metrics = metrics
     sched = Scheduler(engine, reserve=reserve, metrics=metrics,
-                      flight=flight)
+                      flight=flight, slo=SLOConfig.from_wire(slo_kw))
     reader = ChannelReader(session_dir, replica, channel_epoch)
     cursor_w = (CursorWriter(session_dir, replica, channel_epoch)
                 if leader and tp > 1 else None)
@@ -569,6 +594,7 @@ def _replica_session_main(model_cfg_kw: dict, params_path: str,
                 "ttft_s": comp.ttft_s, "tpot_s": comp.tpot_s,
                 "decode_s": comp.decode_s, "preempted": comp.preempted,
                 "n_tokens": len(comp.tokens),
+                "priority": comp.priority,
             }])
             if len(sched.completions) % FLUSH_EVERY_N_COMPLETIONS == 0:
                 recorder.flush()
@@ -590,16 +616,20 @@ def _replica_session_main(model_cfg_kw: dict, params_path: str,
                     # replay after respawn
                     starts.append(cmd["req"]["rid"])
                 evicted.extend(apply(cmd))
+            # enqueue-time budget sheds (typed records, RLT505) fire
+            # inside apply(); tick-time dry-pool sheds extend below
+            sheds = sched.take_sheds()
             if state["stop"] in ("hard", "abort"):
                 if cursor_w is not None and cmds:
                     cursor_w.advance(reader.last_seq, False)
+                payload = {"ack": reader.last_seq}
                 if evicted:
-                    session.put_queue(("batch", replica, {
-                        "ack": reader.last_seq, "evicted":
-                        [[request_to_wire(q), p] for q, p in evicted]}))
-                elif cmds:
-                    session.put_queue(("batch", replica,
-                                       {"ack": reader.last_seq}))
+                    payload["evicted"] = [[request_to_wire(q), p]
+                                          for q, p in evicted]
+                if sheds:
+                    payload["sheds"] = sheds
+                if cmds or evicted or sheds:
+                    session.put_queue(("batch", replica, payload))
                 break
             do_tick = not state["paused"] and sched.busy()
             if cursor_w is not None and (cmds or do_tick):
@@ -610,10 +640,11 @@ def _replica_session_main(model_cfg_kw: dict, params_path: str,
             toks, preempts, dones, ev2 = (run_tick() if do_tick
                                           else ([], [], [], []))
             evicted.extend(ev2)
+            sheds.extend(sched.take_sheds())
             emitted_total += len(toks)
-            if cmds or toks or preempts or dones or evicted:
+            if cmds or toks or preempts or dones or evicted or sheds:
                 # ONE side-channel item per iteration — tokens, acks,
-                # completions, evictions batched (RLT504)
+                # completions, evictions, sheds batched (RLT504)
                 payload: Dict[str, Any] = {}
                 if starts:
                     payload["starts"] = starts
@@ -626,6 +657,8 @@ def _replica_session_main(model_cfg_kw: dict, params_path: str,
                 if evicted:
                     payload["evicted"] = [[request_to_wire(q), p]
                                           for q, p in evicted]
+                if sheds:
+                    payload["sheds"] = sheds
                 if cmds:
                     payload["ack"] = reader.last_seq
                 session.put_queue(("batch", replica, payload))
@@ -670,6 +703,9 @@ def _replica_session_main(model_cfg_kw: dict, params_path: str,
                 apply(cmd)
             if rec.get("tick"):
                 run_tick()
+            # lockstep state only: the LEADER owns shed emission; a
+            # follower drains its identical records to bound the list
+            sched.take_sheds()  # rlt: disable=RLT505
     _record_drain(recorder, sched, replica)
     recorder.flush()
     recorder.close()
@@ -789,6 +825,10 @@ class ServeDriver:
                 "flight_ring": self.cfg.flight_ring,
                 "flight_persist_every": self.cfg.flight_persist_every}
 
+    def _slo_kw(self) -> Optional[dict]:
+        return (self.cfg.slo.to_wire()
+                if self.cfg.slo is not None else None)
+
     # ---- inline ----------------------------------------------------------
 
     def _run_inline(self, requests: Sequence[Request],
@@ -823,12 +863,24 @@ class ServeDriver:
                                   draft_params=self.draft_params)
             engine.warmup()
             sched = Scheduler(engine, reserve=self.cfg.reserve,
-                              metrics=metrics, flight=flight)
+                              metrics=metrics, flight=flight,
+                              slo=self.cfg.slo)
             scheds.append(sched)
             recorders.append(_make_recorder(self.cfg.run_dir, r))
+
+        def note_sheds(r: int, sched) -> None:
+            # typed terminal status for every shed stream — a shed
+            # request is never silently absent from the result (RLT505)
+            for rec in sched.take_sheds():
+                meta[rec["rid"]] = {
+                    "replica": r, "finish_reason": "shed",
+                    **{k: v for k, v in rec.items() if k != "rid"}}
+
         for i, req in enumerate(requests):
             scheds[i % len(scheds)].submit(req)
             outputs[req.rid] = []
+        for r, sched in enumerate(scheds):
+            note_sheds(r, sched)
         # round-robin tick until every replica drains — the inline
         # analog of replicas running concurrently
         while any(s.busy() for s in scheds):
@@ -853,7 +905,9 @@ class ServeDriver:
                         "ttft_s": comp.ttft_s, "tpot_s": comp.tpot_s,
                         "preempted": comp.preempted,
                         "n_tokens": len(comp.tokens),
+                        "priority": comp.priority,
                     }
+                note_sheds(r, sched)
         wall = time.perf_counter() - t0
         for r, sched in enumerate(scheds):
             stats_occ.append(sched.slot_occupancy)
@@ -868,6 +922,9 @@ class ServeDriver:
             "n_requests": len(requests), "n_tokens": n_tokens,
             "wall_s": wall,
             "compile_count": max(s.engine.compile_count for s in scheds),
+            "requests_shed": sum(
+                1 for m in meta.values()
+                if m.get("finish_reason") == "shed"),
         }
         result = ServeResult(outputs=outputs, meta=meta,
                              restarts={r: 0 for r in
@@ -921,6 +978,17 @@ class ServeDriver:
                 elif kind == "done":
                     _, rep, rid, m = item
                     meta[rid] = {"replica": rep, **m}
+                elif kind == "shed":
+                    # typed terminal status: the shed stream ends with
+                    # an explicit record, never silence (RLT505); the
+                    # respawn replay filters on meta, so a shed rid is
+                    # terminal and never double-counted
+                    _, rep, rid, rec = item
+                    meta[rid] = {
+                        "replica": rep, "finish_reason": "shed",
+                        **{k: v for k, v in rec.items()
+                           if k != "rid"}}
+                    outputs[rid] = []
                 elif kind == "live":
                     warmups[item[1]].append(item[2]["warmup_s"])
 
@@ -953,7 +1021,8 @@ class ServeDriver:
                             [_req_dict(q) for q in remaining], r,
                             self.cfg.run_dir,
                             self.cfg.compile_cache_dir, rep_fault,
-                            fault_dir, self._metrics_cfg()),
+                            fault_dir, self._metrics_cfg(),
+                            self._slo_kw()),
                         on_queue_item=on_queue_item)
                     with lock:
                         occupancy[r] = res[0]["occupancy"]
@@ -1015,6 +1084,9 @@ class ServeDriver:
             "compile_count": (max(compile_counts.values())
                               if compile_counts else None),
             "restarts_total": sum(restarts.values()),
+            "requests_shed": sum(
+                1 for m in meta.values()
+                if m.get("finish_reason") == "shed"),
         }
         result = ServeResult(outputs=outputs, meta=meta,
                              restarts=restarts, stats=stats)
@@ -1210,7 +1282,8 @@ class ServeDriver:
                               draft_params=self.draft_params)
         engine.warmup()
         sched = Scheduler(engine, reserve=self.cfg.reserve,
-                          metrics=metrics, flight=flight)
+                          metrics=metrics, flight=flight,
+                          slo=self.cfg.slo)
         recorder = _make_recorder(self.cfg.run_dir, r)
         warm_s = time.perf_counter() - t0
         self._next_replica += 1
@@ -1369,10 +1442,12 @@ class ServeDriver:
                     "ttft_s": comp.ttft_s, "tpot_s": comp.tpot_s,
                     "preempted": comp.preempted,
                     "n_tokens": len(comp.tokens),
+                    "priority": comp.priority,
                 }
                 if len(rep.sched.completions) % \
                         FLUSH_EVERY_N_COMPLETIONS == 0:
                     rep.recorder.flush()
+            self._drain_sheds(r, rep.sched)
             done.extend(completions)
         self._session_ticks += 1
         dm = self.driver_metrics
@@ -1452,6 +1527,9 @@ class ServeDriver:
         for rep in self.replicas.values():
             if rep.state == "stopped":
                 continue
+            # enqueue-time sheds on an otherwise-idle session never saw
+            # a tick — surface them before the scheduler closes
+            self._drain_sheds(rep.id, rep.sched)
             _record_drain(rep.recorder, rep.sched, rep.id)
             self._stop_replica(rep)
         wall = time.perf_counter() - self._session_t0
@@ -1473,6 +1551,9 @@ class ServeDriver:
             "submit_deferrals":
                 self.driver_metrics.counters().get(
                     "submit_deferrals", 0),
+            "requests_shed":
+                self.driver_metrics.counters().get(
+                    "requests_shed", 0),
             "last_spawn_s": self.last_spawn_s,
         }
         result = ServeResult(
@@ -1485,6 +1566,19 @@ class ServeDriver:
         return result
 
     # ---- session internals ----------------------------------------------
+
+    def _drain_sheds(self, r: int, sched) -> None:
+        """Turn a scheduler's typed shed records into terminal stream
+        statuses (finish_reason="shed" + retry-after hint) — the
+        graceful-overload contract: shed work is answered, never
+        silently dropped (RLT505)."""
+        for rec in sched.take_sheds():
+            rid = rec["rid"]
+            self.meta[rid] = {
+                "replica": r, "finish_reason": "shed",
+                **{k: v for k, v in rec.items() if k != "rid"}}
+            self.outputs[rid] = []
+            self.driver_metrics.count("requests_shed")
 
     def _pick_replica(self) -> Optional[int]:
         live = self.live_ids
@@ -1710,7 +1804,8 @@ class ServeDriver:
                          self.cfg.reserve, rep.id, self.cfg.run_dir,
                          self._session_dir, self.cfg.compile_cache_dir,
                          rep_fault, self._session_dir,
-                         self._metrics_cfg(), epoch, tp),
+                         self._metrics_cfg(), epoch, tp,
+                         self._slo_kw()),
                         {}, tp, coordinator, self.cfg.platform,
                         self.cfg.cpu_devices_per_rank),
                     per_rank_args=[(k, (k,)) for k in range(tp)],
@@ -1800,6 +1895,22 @@ class ServeDriver:
                 self.meta[rid] = {"replica": rep.id, **m}
                 rep.assigned = [q for q in rep.assigned
                                 if q.rid != rid]
+            for rec in payload.get("sheds", ()):
+                # typed terminal status for a shed stream (RLT505) —
+                # idempotent across epoch rolls: a rid already terminal
+                # in meta is not re-counted, and dropping it from the
+                # assignment ledger keeps the respawn replay from
+                # resubmitting (and re-shedding) the dead epoch's sheds
+                rid = rec["rid"]
+                if (self.meta.get(rid, {}).get("finish_reason")
+                        != "shed"):
+                    self.driver_metrics.count("requests_shed")
+                self.meta[rid] = {
+                    "replica": rep.id, "finish_reason": "shed",
+                    **{k: v for k, v in rec.items() if k != "rid"}}
+                self.outputs[rid] = []
+                rep.assigned = [q for q in rep.assigned
+                                if q.rid != rid]
             for wire, preempts in payload.get("evicted", ()):
                 # a draining/stopping replica handing work back for
                 # the survivors (bitwise replay seam)
@@ -1880,6 +1991,9 @@ class ServeDriver:
             "submit_deferrals":
                 self.driver_metrics.counters().get(
                     "submit_deferrals", 0),
+            "requests_shed":
+                self.driver_metrics.counters().get(
+                    "requests_shed", 0),
             "last_spawn_s": self.last_spawn_s,
         }
         result = ServeResult(
@@ -1979,4 +2093,5 @@ def _req_dict(req: Request) -> dict:
     return {"rid": req.rid, "prompt": np.asarray(req.prompt).tolist(),
             "max_new_tokens": req.max_new_tokens,
             "temperature": req.temperature, "top_k": req.top_k,
-            "seed": req.seed, "eos_id": req.eos_id}
+            "seed": req.seed, "eos_id": req.eos_id,
+            "priority": req.priority}

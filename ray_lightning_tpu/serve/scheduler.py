@@ -30,6 +30,12 @@ from ray_lightning_tpu.serve.kv_cache import (
 from ray_lightning_tpu.telemetry.metrics import NULL_FLIGHT, NULL_METRICS
 
 
+#: traffic classes, best first — the index is the preemption rank
+#: (lower outranks higher; docs/SERVING.md "traffic & SLO classes")
+PRIORITIES = ("latency_critical", "standard", "best_effort")
+_PRIORITY_RANK = {p: i for i, p in enumerate(PRIORITIES)}
+
+
 @dataclasses.dataclass
 class Request:
     """One generation request. ``seed`` drives the slot's private RNG —
@@ -46,6 +52,10 @@ class Request:
     eos_id: Optional[int] = None
     #: host wall time the request entered the queue (queue_wait span)
     arrival: float = 0.0
+    #: traffic class (PRIORITIES). Inert unless the scheduler is built
+    #: with an SLOConfig — priority-off runs the historical FIFO/age
+    #: policy no matter what the label says (test-pinned)
+    priority: str = "standard"
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -53,6 +63,10 @@ class Request:
             raise ValueError(f"request {self.rid}: empty prompt")
         if self.max_new_tokens < 1:
             raise ValueError(f"request {self.rid}: max_new_tokens < 1")
+        if self.priority not in _PRIORITY_RANK:
+            raise ValueError(
+                f"request {self.rid}: priority {self.priority!r} not in "
+                f"{PRIORITIES}")
 
 
 @dataclasses.dataclass
@@ -64,12 +78,119 @@ class Completion:
     ttft_s: float                   # admission -> first token (host wall)
     decode_s: float                 # first token -> completion
     preempted: int = 0              # times this request was re-queued
+    priority: str = "standard"      # the request's traffic class
 
     @property
     def tpot_s(self) -> float:
         """Mean time per output token after the first."""
         n = max(1, len(self.tokens) - 1)
         return self.decode_s / n
+
+
+@dataclasses.dataclass(frozen=True)
+class ClassSLO:
+    """Per-class service targets + admission budget.
+
+    ``queue_budget`` is the class's admission budget: with an SLOConfig
+    armed, a new arrival in a SHED class whose class queue already
+    holds this many requests is rejected with a typed shed record
+    instead of queueing unboundedly behind traffic it can never
+    outrank. ``None`` = unlimited."""
+
+    ttft_p95_s: float = 2.0
+    tpot_p95_s: float = 0.5
+    queue_budget: Optional[int] = None
+
+
+def _default_classes() -> Dict[str, ClassSLO]:
+    return {
+        "latency_critical": ClassSLO(ttft_p95_s=0.5, tpot_p95_s=0.2),
+        "standard": ClassSLO(ttft_p95_s=2.0, tpot_p95_s=0.5),
+        "best_effort": ClassSLO(ttft_p95_s=30.0, tpot_p95_s=2.0),
+    }
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOConfig:
+    """Arms traffic-aware scheduling (docs/SERVING.md "traffic & SLO
+    classes"). With ``slo=None`` (the default everywhere) the scheduler
+    runs the byte-identical historical policy: FIFO admission,
+    oldest-preempts-youngest growth, no shedding, no class-keyed
+    metrics — the priority label on a Request is inert.
+
+    Armed, three seams change, all host-side (the compiled step never
+    sees a priority):
+
+    * admission order becomes (class rank, FIFO) — stable within a
+      class, so the anti-livelock age ordering survives;
+    * the growth-stall seam preempts by (class rank, age): a grower may
+      evict strictly-lower-class slots of ANY age, same-class slots
+      only if strictly younger — never peers-or-better; a blocked
+      higher-class ARRIVAL may preempt a strictly-lower-class slot
+      (`preempt_on_admit`);
+    * overload sheds ``shed_classes`` load explicitly: a breached
+      class ``queue_budget`` or a dry pool blocking a higher class
+      produces a typed shed record with a capped-exponential
+      ``retry_after_s`` hint — never silence.
+    """
+
+    classes: Dict[str, ClassSLO] = dataclasses.field(
+        default_factory=_default_classes)
+    #: classes eligible for load shedding under overload
+    shed_classes: Tuple[str, ...] = ("best_effort",)
+    #: shed queued shed-class work when a dry pool blocks the
+    #: admission of a strictly higher class
+    shed_on_dry_pool: bool = True
+    #: a blocked higher-class arrival may preempt a strictly-lower-
+    #: class slot to take its blocks (never a peer)
+    preempt_on_admit: bool = True
+    #: capped-exponential retry-after hint: base * 2^(sheds-1), capped
+    retry_after_base_s: float = 0.5
+    retry_after_cap_s: float = 30.0
+
+    def __post_init__(self):
+        for name in self.classes:
+            if name not in _PRIORITY_RANK:
+                raise ValueError(f"SLOConfig: unknown class {name!r}")
+        for name in self.shed_classes:
+            if name not in _PRIORITY_RANK:
+                raise ValueError(
+                    f"SLOConfig: unknown shed class {name!r}")
+
+    def slo_for(self, priority: str) -> ClassSLO:
+        return self.classes.get(priority, ClassSLO())
+
+    def retry_after(self, n_sheds: int) -> float:
+        """Capped-exponential backoff hint for the n-th shed of one
+        request (n_sheds >= 1)."""
+        return min(self.retry_after_cap_s,
+                   self.retry_after_base_s * (2.0 ** max(0, n_sheds - 1)))
+
+    def to_wire(self) -> dict:
+        """JSON-safe payload (process-backend worker spawn)."""
+        return {
+            "classes": {k: dataclasses.asdict(v)
+                        for k, v in self.classes.items()},
+            "shed_classes": list(self.shed_classes),
+            "shed_on_dry_pool": self.shed_on_dry_pool,
+            "preempt_on_admit": self.preempt_on_admit,
+            "retry_after_base_s": self.retry_after_base_s,
+            "retry_after_cap_s": self.retry_after_cap_s,
+        }
+
+    @staticmethod
+    def from_wire(d: Optional[dict]) -> Optional["SLOConfig"]:
+        if d is None:
+            return None
+        return SLOConfig(
+            classes={k: ClassSLO(**v)
+                     for k, v in d.get("classes", {}).items()},
+            shed_classes=tuple(d.get("shed_classes", ("best_effort",))),
+            shed_on_dry_pool=d.get("shed_on_dry_pool", True),
+            preempt_on_admit=d.get("preempt_on_admit", True),
+            retry_after_base_s=d.get("retry_after_base_s", 0.5),
+            retry_after_cap_s=d.get("retry_after_cap_s", 30.0),
+        )
 
 
 class _Slot:
@@ -173,7 +294,8 @@ class Scheduler:
     """
 
     def __init__(self, engine: DecodeEngine, reserve: str = "worst_case",
-                 metrics=None, flight=None, prefix_cache: bool = False):
+                 metrics=None, flight=None, prefix_cache: bool = False,
+                 slo: Optional[SLOConfig] = None):
         if reserve not in ("worst_case", "on_demand"):
             raise ValueError(f"reserve={reserve!r}")
         if prefix_cache and engine.cfg.prefill_batch != 1:
@@ -244,6 +366,17 @@ class Scheduler:
         #: spans so a preempt-heavy run stops under-reporting
         #: queue_wait without double-counting the replayed prefix
         self.last_preemption_details: List[dict] = []
+        #: traffic-aware policy (None = the byte-identical historical
+        #: scheduler: FIFO + oldest-preempts-youngest, no shedding, no
+        #: class-keyed metrics — test-pinned)
+        self.slo = slo
+        #: typed shed records since the last `take_sheds()` — every
+        #: rejected/deferred request leaves one; a consumer that drops
+        #: them ships silent request loss (lint rule RLT505)
+        self.last_sheds: List[dict] = []
+        #: per-rid shed count (drives the capped-exponential
+        #: retry_after_s hint across resubmissions)
+        self._shed_counts: Dict[str, int] = {}
         self._seq = 0
         self._queue_wait: Dict[str, float] = {}
         #: running occupancy: decoding-slot fraction summed over ticks
@@ -273,7 +406,108 @@ class Scheduler:
                 f"scheduler is draining — request {req.rid} must route "
                 "to a live replica (driver bug: admissions are closed "
                 "here)")
-        self.queue.append((req, preempts))
+        if self.slo is None:
+            self.queue.append((req, preempts))
+            return
+        budget = self.slo.slo_for(req.priority).queue_budget
+        if (req.priority in self.slo.shed_classes
+                and budget is not None
+                and self._queued_in_class(req.priority) >= budget):
+            self._shed(req, preempts, "queue_budget")
+            return
+        self._insert_by_class(req, preempts, front_of_class=False)
+
+    def take_sheds(self) -> List[dict]:
+        """Drain the typed shed records (explicit rejection/deferral —
+        each carries rid, priority, reason, retry_after_s). The driver
+        turns every record into a terminal status on the stream; a
+        consumer that drops them ships silent request loss (RLT505)."""
+        out, self.last_sheds = self.last_sheds, []
+        return out
+
+    # ---- traffic-aware policy helpers (no-ops with slo=None) -------------
+
+    def _queued_in_class(self, priority: str) -> int:
+        return sum(1 for q, _ in self.queue if q.priority == priority)
+
+    def _insert_by_class(self, req: Request, preempts: int,
+                         front_of_class: bool) -> None:
+        """Class-ordered queue insert, FIFO-stable within a class. A
+        new arrival goes BEHIND its class peers (front_of_class=False);
+        a preempted requeue goes AHEAD of them (it is the oldest of its
+        class — the anti-livelock age ordering the historical
+        appendleft encoded, scoped to the class)."""
+        r = _PRIORITY_RANK[req.priority]
+        i = len(self.queue)
+        for j, (q, _) in enumerate(self.queue):
+            rq = _PRIORITY_RANK[q.priority]
+            if rq > r or (front_of_class and rq == r):
+                i = j
+                break
+        self.queue.insert(i, (req, preempts))
+
+    def _shed(self, req: Request, preempts: int, reason: str) -> None:
+        """Reject/defer one request with a typed record — the explicit
+        overload paper trail (never silence). retry_after_s is
+        capped-exponential in this rid's shed count."""
+        n = self._shed_counts.get(req.rid, 0) + 1
+        self._shed_counts[req.rid] = n
+        rec = {
+            "rid": req.rid,
+            "priority": req.priority,
+            "reason": reason,
+            "retry_after_s": self.slo.retry_after(n),
+            "sheds": n,
+            "preempted": preempts,
+        }
+        self.last_sheds.append(rec)
+        self._queue_wait.pop(req.rid, None)
+        self.metrics.count("sheds")
+        self.metrics.count(f"sheds_{req.priority}")
+        self.flight.record("shed", rid=req.rid, priority=req.priority,
+                           reason=reason,
+                           retry_after_s=rec["retry_after_s"])
+
+    def _shed_starved(self) -> None:
+        """Dry pool blocking the queue head: queued shed-class work of
+        STRICTLY lower class than the blocked head is shed with
+        explicit records — it sits behind traffic it can never outrank,
+        so leaving it queued is silent starvation."""
+        if self.slo is None or not self.slo.shed_on_dry_pool:
+            return
+        head, _ = self.queue[0]
+        r = _PRIORITY_RANK[head.priority]
+        keep: Deque[Tuple[Request, int]] = deque()
+        for req, preempts in self.queue:
+            if (req.priority in self.slo.shed_classes
+                    and _PRIORITY_RANK[req.priority] > r):
+                self._shed(req, preempts, "dry_pool")
+            else:
+                keep.append((req, preempts))
+        self.queue = keep
+
+    def _admit_preempt(self) -> bool:
+        """A blocked higher-class ARRIVAL preempts ONE strictly-lower-
+        class slot (lowest class first, youngest within it) to take its
+        slot + blocks — never a peer, so within-class age ordering (and
+        with it the drain guarantee) is untouched. False when the
+        policy is off or no strictly-lower-class victim exists."""
+        if self.slo is None or not self.slo.preempt_on_admit:
+            return False
+        if not self.queue:
+            return False
+        head, _ = self.queue[0]
+        r = _PRIORITY_RANK[head.priority]
+        victims = [s for s in self.slots
+                   if _PRIORITY_RANK[self.slots[s].req.priority] > r]
+        if not victims:
+            return False
+        victim = max(victims, key=lambda s: (
+            _PRIORITY_RANK[self.slots[s].req.priority],
+            self.slots[s].seq))
+        self.metrics.count("admit_preemptions")
+        self._preempt(victim)
+        return True
 
     def busy(self) -> bool:
         return bool(self.queue or self.slots)
@@ -430,10 +664,18 @@ class Scheduler:
             # driver's eviction pass, never re-admits here
             return
         if self.cfg.prefill_batch == 1:
-            while self.queue and self.free_slots:
+            # slo=None: `_admit_preempt()` is a constant False, so this
+            # is exactly the historical free-slot FIFO loop
+            while self.queue and (self.free_slots
+                                  or self._admit_preempt()):
                 s = self._admit_one(self.queue[0][0].prompt.size)
                 if s is None:
-                    # pool short: the queue head defers to a later tick
+                    # pool short: try taking a strictly-lower-class
+                    # slot's blocks; otherwise shed starved shed-class
+                    # work behind the blocked head and defer
+                    if self._admit_preempt():
+                        continue
+                    self._shed_starved()
                     self.metrics.count("admission_deferrals")
                     return
                 self.prefill_groups.append(
@@ -467,6 +709,15 @@ class Scheduler:
             if not group:
                 return
             self.prefill_groups.append(_PrefillGroup(group, width))
+
+    def _policy_key(self, slot: _Slot) -> Tuple[int, int]:
+        """Preemption/growth policy order: (class rank, admission age).
+        With slo=None every rank is 0, so the order — and every
+        decision derived from it — is the historical seq-only age
+        ordering (test-pinned)."""
+        if self.slo is None:
+            return (0, slot.seq)
+        return (_PRIORITY_RANK[slot.req.priority], slot.seq)
 
     def _grow(self, s: int, slot: _Slot) -> bool:
         """Ensure every block a decode write can touch this tick
@@ -540,7 +791,13 @@ class Scheduler:
                     self.prefill_groups.remove(g)
                 break
         self.free_slots.append(s)
-        self.queue.appendleft((slot.req, slot.preempted + 1))
+        if self.slo is None:
+            self.queue.appendleft((slot.req, slot.preempted + 1))
+        else:
+            # front of its CLASS, not of the whole queue — a preempted
+            # best-effort request must not jump a latency-critical one
+            self._insert_by_class(slot.req, slot.preempted + 1,
+                                  front_of_class=True)
 
     def _retire(self, s: int, reason: str) -> Completion:
         slot = self.slots.pop(s)
@@ -554,6 +811,7 @@ class Scheduler:
             ttft_s=first - slot.admitted_at,
             decode_s=now - first,
             preempted=slot.preempted,
+            priority=slot.req.priority,
         )
         self.alloc.free(slot.blocks)
         self.tables[s, :] = 0
@@ -569,6 +827,15 @@ class Scheduler:
             m.observe("ttft_s", comp.ttft_s)
             m.observe("tpot_s", comp.tpot_s)
             m.observe("decode_s", comp.decode_s)
+            if self.slo is not None:
+                # class-keyed twins: `observe()` auto-creates the
+                # histogram, so `serving.ttft_<class>_p95_s` watch
+                # selectors resolve with zero grammar change
+                p = comp.priority
+                m.count(f"completions_{p}")
+                m.observe(f"ttft_{p}_s", comp.ttft_s)
+                m.observe(f"tpot_{p}_s", comp.tpot_s)
+                m.observe(f"queue_wait_{p}_s", comp.queue_wait_s)
         self.flight.record("retire", rid=comp.rid, slot=s, reason=reason,
                            tokens=len(comp.tokens),
                            preempted=comp.preempted)
@@ -584,29 +851,36 @@ class Scheduler:
         self._admit()
         # growth check before the step: every decoding slot must own
         # the block its write lands in. On a dry pool a grower may only
-        # evict slots STRICTLY YOUNGER than itself (decoding or
-        # prefilling — a re-admitted request is always the youngest);
-        # with no younger victim it preempts ITSELF. The oldest slot is
-        # therefore never evicted and strictly progresses every tick,
-        # so the system drains — any policy that lets a younger grower
-        # evict an older slot (or the grower evict itself while holding
-        # victims) lets two oversubscribed requests cycle forever
-        # (observed livelock, test-pinned against).
+        # evict slots STRICTLY AFTER itself in policy order (decoding
+        # or prefilling — a re-admitted request is always the
+        # youngest); with no victim it preempts ITSELF. Policy order is
+        # (class rank, admission seq): with slo=None every rank is 0
+        # and this is the byte-identical historical age ordering; armed,
+        # a grower may evict strictly-lower-class slots of ANY age and
+        # same-class slots only if strictly younger — never peers. The
+        # policy-minimal slot is therefore never evicted and strictly
+        # progresses every tick, so the system drains — any policy that
+        # lets a later grower evict an earlier slot (or the grower
+        # evict itself while holding victims) lets two oversubscribed
+        # requests cycle forever (observed livelock, test-pinned
+        # against).
         for s in sorted([s for s in self.slots if self.decoding[s]],
-                        key=lambda s: self.slots[s].seq):
+                        key=lambda s: self._policy_key(self.slots[s])):
             if s not in self.slots:
                 continue  # preempted as a victim earlier this tick
             me = self.slots[s]
+            me_key = self._policy_key(me)
             while not self._grow(s, me):
                 # a dry pool at a growth boundary: the signal item 1(c)
                 # autoscale watches — every stall is one eviction (or a
                 # self-preempt) the pool's size forced
                 self.metrics.count("growth_stalls")
                 victims = [v for v in self.slots
-                           if self.slots[v].seq > me.seq]
+                           if self._policy_key(self.slots[v]) > me_key]
                 if victims:
                     self._preempt(max(
-                        victims, key=lambda v: self.slots[v].seq))
+                        victims,
+                        key=lambda v: self._policy_key(self.slots[v])))
                 elif len(self.slots) > 1:
                     # s is the youngest: yield its blocks to its elders
                     self._preempt(s)
@@ -756,6 +1030,14 @@ class Scheduler:
                 m.gauge("blocks_free", free)
                 m.gauge("blocks_in_use", total - free)
                 m.gauge("slot_occupancy", float(was_decoding.mean()))
+                if self.slo is not None:
+                    # per-class pressure feeds `load_signal()`'s
+                    # pressure_<class> fields (autoscale + watch);
+                    # emitted only when the policy is armed so a
+                    # priority-off run's metrics stream is unchanged
+                    for p in PRIORITIES:
+                        m.gauge(f"queue_depth_{p}",
+                                self._queued_in_class(p))
             self.flight.record("tick", tick=self._ticks,
                                queue_depth=queue_depth,
                                decoding=decoding, prefilling=prefilling,

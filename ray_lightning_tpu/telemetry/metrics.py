@@ -668,6 +668,12 @@ def load_signal_from_parsed(newest_per_replica: Dict[str, dict],
     blocks_free_fraction: Optional[float] = None
     per_replica: Dict[str, dict] = {}
     retired: List[str] = []
+    # per-traffic-class pooling (scheduler with SLOConfig armed emits
+    # queue_depth_<class> gauges + sheds_<class> counters; absent on a
+    # priority-off run, so the signal shape stays historical there)
+    cls_qd_window: Dict[str, List[float]] = {}
+    cls_qd_now: Dict[str, float] = {}
+    cls_sheds: Dict[str, float] = {}
     for rep, entry in sorted(newest_per_replica.items()):
         parsed = entry["parsed"]
         g_last = parsed["gauges"]
@@ -687,6 +693,17 @@ def load_signal_from_parsed(newest_per_replica: Dict[str, dict],
         qd_window.extend(qd)
         occ_window.extend(occ)
         qd_now += float(g_last.get("queue_depth", 0.0))
+        for name, v in g_last.items():
+            if name.startswith("queue_depth_"):
+                cls = name[len("queue_depth_"):]
+                cls_qd_now[cls] = cls_qd_now.get(cls, 0.0) + float(v)
+                cls_qd_window.setdefault(cls, []).extend(
+                    float((s.get("g") or {}).get(name, 0.0))
+                    for s in recent)
+        for name, v in parsed["counters"].items():
+            if name.startswith("sheds_"):
+                cls = name[len("sheds_"):]
+                cls_sheds[cls] = cls_sheds.get(cls, 0.0) + float(v)
         total_slots += (g_last.get("decoding_slots", 0.0)
                         + g_last.get("prefilling_slots", 0.0)
                         + g_last.get("free_slots", 0.0))
@@ -724,6 +741,17 @@ def load_signal_from_parsed(newest_per_replica: Dict[str, dict],
         signal["replicas_retired"] = len(retired)
     if blocks_free_fraction is not None:
         signal["blocks_free_fraction"] = blocks_free_fraction
+    # flat per-class fields (watch selectors + autoscale read these by
+    # name: load.pressure_latency_critical etc.) — present only when a
+    # traffic-aware scheduler reported per-class gauges
+    for cls in sorted(cls_qd_now):
+        win = sorted(cls_qd_window.get(cls) or [0.0])
+        p50 = win[len(win) // 2]
+        signal[f"queue_depth_now_{cls}"] = cls_qd_now[cls]
+        signal[f"pressure_{cls}"] = (p50 / total_slots
+                                     if total_slots else None)
+    for cls in sorted(cls_sheds):
+        signal[f"sheds_{cls}"] = cls_sheds[cls]
     return signal
 
 

@@ -132,6 +132,46 @@ BUILTIN_RULES: Tuple[WatchRule, ...] = (
 )
 
 
+def class_slo_rules(slo, sustain: int = 1) -> Tuple[WatchRule, ...]:
+    """Per-traffic-class SLO rules from a `serve.scheduler.SLOConfig`
+    (duck-typed: anything with ``.classes`` / ``.shed_classes``): one
+    TTFT-p95 and one TPOT-p95 rule per class against that class's own
+    merged histogram (``serving.ttft_<class>_p95_s`` — class-keyed
+    hists exist only when the scheduler runs with the SLOConfig
+    armed), plus one shed-visibility rule per shed class
+    (``load.sheds_<class>``). A breach in ONE class fires a
+    class-named incident instead of being averaged into the pooled
+    tail; latency_critical breaches page, the rest warn
+    (docs/SERVING.md "traffic & SLO classes")."""
+    rules: list = []
+    for cls in sorted(slo.classes):
+        spec = slo.classes[cls]
+        sev = "page" if cls == "latency_critical" else "warn"
+        rules.append(WatchRule(
+            f"slo_ttft_{cls}", f"serving.ttft_{cls}_p95_s", ">",
+            spec.ttft_p95_s, sustain=sustain, severity=sev,
+            description=f"{cls} TTFT p95 above its per-class SLO "
+                        f"target ({spec.ttft_p95_s:g}s) — this "
+                        "class's admission latency breached, whatever "
+                        "the pooled tail says"))
+        rules.append(WatchRule(
+            f"slo_tpot_{cls}", f"serving.tpot_{cls}_p95_s", ">",
+            spec.tpot_p95_s, sustain=sustain, severity=sev,
+            description=f"{cls} TPOT p95 above its per-class SLO "
+                        f"target ({spec.tpot_p95_s:g}s) — decode "
+                        "progress for this class is being crowded "
+                        "out"))
+    for cls in slo.shed_classes:
+        rules.append(WatchRule(
+            f"shed_{cls}", f"load.sheds_{cls}", ">=", 1, sustain=1,
+            severity="warn",
+            description=f"overload shed {cls} work (typed records "
+                        "with retry-after hints, never silence) — "
+                        "expected under a protective burst, but a "
+                        "paper trail the run must carry"))
+    return tuple(rules)
+
+
 @dataclasses.dataclass
 class WatchConfig:
     """``watch=`` coercion target (supervisor / controller / CLI)."""
@@ -356,11 +396,14 @@ class MetricSurfaces:
                                for le, c in h.sketch()]}
         if group == "load":
             sig = self._load()
+            keys = ["available", "pressure", "queue_depth_now",
+                    "queue_depth_p50", "occupancy", "total_slots",
+                    "replicas_reporting"]
+            if field not in keys:
+                keys.append(field)  # class-scoped selectors carry
+                #                     their own flat field as evidence
             return {"load_signal": {
-                k: sig[k] for k in
-                ("available", "pressure", "queue_depth_now",
-                 "queue_depth_p50", "occupancy", "total_slots",
-                 "replicas_reporting") if k in sig}}
+                k: sig[k] for k in keys if k in sig}}
         if group == "goodput":
             g = self._goodput() or {}
             return {"goodput": {k: g[k] for k in

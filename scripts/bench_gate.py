@@ -13,6 +13,11 @@ prior value is the per-metric max — speed can only go up:
                              (static, carried even on skip lines)
     goodput_fraction         productive share of the headline
                              measurement window (measured)
+    slo_attainment_latency_critical
+                             fraction of latency-critical completions
+                             meeting the class TTFT target in the
+                             bench's mixed-class SLO burst (ISSUE 20;
+                             measured, waived on skip lines)
 
 Bounded metrics (upper limits, not ratchets):
 
@@ -84,6 +89,11 @@ RATCHETED = {
     # lands — may only grow). Both measured: waived on skip lines.
     "shared_block_fraction": "shared_block_fraction",
     "accepted_tokens_per_step": "accepted_tokens_per_step",
+    # ISSUE 20: fraction of latency-critical completions meeting the
+    # class TTFT target in the bench's mixed-class SLO burst (1.0 when
+    # every paying request held its SLO while best-effort shed).
+    # Measured: waived on environmental skip lines.
+    "slo_attainment_latency_critical": "slo_attainment_latency_critical",
 }
 
 #: keys computed by static analysis (no hardware needed) — carried on

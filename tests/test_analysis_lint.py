@@ -917,3 +917,104 @@ def test_rlt309_suppressible():
         "                    prompt=np.concatenate([sys_prompt, "
         "tail])))\n")
     assert "RLT309" not in rules_of(fs)
+
+
+# ---- RLT505 silent request drop (docs/SERVING.md ---------------------------
+# ---- "traffic & SLO classes") ----------------------------------------------
+
+
+def test_rlt505_except_pass_around_submit_fires():
+    # the request vanishes with no terminal status, no shed record
+    fs = lint(
+        "def pump(driver, reqs):\n"
+        "    for r in reqs:\n"
+        "        try:\n"
+        "            driver.submit(r)\n"
+        "        except Exception:\n"
+        "            pass\n")
+    assert "RLT505" in rules_of(fs)
+
+
+def test_rlt505_bare_except_continue_around_enqueue_fires():
+    fs = lint(
+        "def pump(sched, reqs):\n"
+        "    for r in reqs:\n"
+        "        try:\n"
+        "            sched.enqueue(r, 0)\n"
+        "        except:\n"
+        "            continue\n")
+    assert "RLT505" in rules_of(fs)
+
+
+def test_rlt505_handled_submit_quiet():
+    # recording a terminal outcome (or re-raising) is the contract
+    fs = lint(
+        "def pump(driver, reqs, meta):\n"
+        "    for r in reqs:\n"
+        "        try:\n"
+        "            driver.submit(r)\n"
+        "        except Exception as exc:\n"
+        "            meta[r.rid] = {'finish_reason': 'error',\n"
+        "                           'error': str(exc)}\n")
+    assert "RLT505" not in rules_of(fs)
+
+
+def test_rlt505_narrow_except_quiet():
+    # a typed, expected exception is a decision, not a swallow
+    fs = lint(
+        "def pump(driver, req):\n"
+        "    try:\n"
+        "        driver.submit(req)\n"
+        "    except ValueError:\n"
+        "        pass\n")
+    assert "RLT505" not in rules_of(fs)
+
+
+def test_rlt505_bare_take_sheds_fires():
+    # records produced and immediately discarded
+    fs = lint(
+        "def tick(sched):\n"
+        "    sched.tick()\n"
+        "    sched.take_sheds()\n")
+    assert "RLT505" in rules_of(fs)
+
+
+def test_rlt505_consumed_take_sheds_quiet():
+    fs = lint(
+        "def tick(sched, meta):\n"
+        "    sched.tick()\n"
+        "    for rec in sched.take_sheds():\n"
+        "        meta[rec['rid']] = {'finish_reason': 'shed', **rec}\n")
+    assert "RLT505" not in rules_of(fs)
+
+
+def test_rlt505_buffer_clear_fires():
+    fs = lint(
+        "def reset(sched):\n"
+        "    sched.last_sheds.clear()\n"
+        "    sched.last_preemptions.clear()\n")
+    assert "RLT505" in rules_of(fs)
+
+
+def test_rlt505_quiet_in_traced_code():
+    # under jit there is no scheduler to drop from — same scope rule
+    # as the other serve-loop lints
+    fs = lint(
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(sched_like, x):\n"
+        "    try:\n"
+        "        sched_like.submit(x)\n"
+        "    except Exception:\n"
+        "        pass\n"
+        "    return x\n")
+    assert "RLT505" not in rules_of(fs)
+
+
+def test_rlt505_suppressible():
+    # the lockstep follower discards on purpose: the leader owns
+    # shed emission (serve/driver.py _replica_session_main)
+    fs = lint(
+        "def follower(sched):\n"
+        "    sched.take_sheds()  # rlt: disable=RLT505\n")
+    assert "RLT505" not in rules_of(fs)

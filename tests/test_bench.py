@@ -402,7 +402,9 @@ def test_skip_line_carries_serving_schema(monkeypatch, capsys):
         "ttft_cold_s", "ttft_warm_s", "ttft_p99_s", "slot_occupancy",
         "serving_attention_path", "serving_prefill_path",
         "serve_metrics", "scale_up_s", "autoscale",
-        "shared_block_fraction", "accepted_tokens_per_step"}
+        "shared_block_fraction", "accepted_tokens_per_step",
+        "slo_attainment", "slo_attainment_latency_critical",
+        "shed_fraction"}
     assert "scale_up_s" in serving["autoscale_schema"]  # ISSUE 13
     assert serving["flagship_plan"]["pool_bytes"] > 0
     # measured serving values belong to success lines only

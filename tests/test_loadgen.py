@@ -1,0 +1,460 @@
+"""Trace-driven load harness + traffic & SLO classes (ISSUE 20,
+docs/SERVING.md "traffic & SLO classes"): the versioned
+byte-deterministic trace format, the seeded arrival generator, the
+virtual-clock runner (and the autoscale/sim shim over it), the
+traffic-aware scheduler seams — class-major admission,
+strictly-lower-class admit-preemption (never peers), typed budget
+sheds with capped-exponential retry-after hints, `slo=None`
+byte-identical to the historical FIFO policy — per-class
+metrics/watch accounting, the bench/bench_gate traffic fields, and
+(slow) a mid-burst SIGKILL on a process replica replaying bitwise
+with per-class accounting consistent across the channel epoch roll."""
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.loadgen.generator import (
+    WorkloadConfig,
+    generate_events,
+)
+from ray_lightning_tpu.loadgen.runner import run_trace
+from ray_lightning_tpu.loadgen.trace import (
+    TraceEvent,
+    arrivals_by_tick,
+    dump_trace,
+    events_from_arrivals,
+    read_trace,
+    to_request,
+    write_trace,
+)
+from ray_lightning_tpu.serve.scheduler import (
+    ClassSLO,
+    Request,
+    Scheduler,
+    SLOConfig,
+)
+
+# ---- trace format ----------------------------------------------------------
+
+
+def test_trace_bytes_deterministic_and_seed_sensitive():
+    wl = WorkloadConfig(seed=5, n_requests=12, process="mmpp")
+    a = dump_trace(generate_events(wl), wl.meta())
+    b = dump_trace(generate_events(wl), wl.meta())
+    assert a == b, "same config must serialize byte-identically"
+    wl2 = WorkloadConfig(seed=6, n_requests=12, process="mmpp")
+    assert a != dump_trace(generate_events(wl2), wl2.meta())
+
+
+def test_trace_round_trip_and_version_refusal(tmp_path):
+    wl = WorkloadConfig(seed=3, n_requests=6)
+    events = generate_events(wl)
+    path = str(tmp_path / "t.jsonl")
+    write_trace(path, events, wl.meta())
+    header, back = read_trace(path)
+    assert header["meta"]["seed"] == 3
+    assert dump_trace(back, header["meta"]) == \
+        dump_trace(events, wl.meta())
+    # a future trace version must be refused, never misread
+    lines = open(path).read().splitlines()
+    doc = json.loads(lines[0])
+    doc["version"] = 999
+    with open(path, "w") as f:
+        f.write("\n".join([json.dumps(doc)] + lines[1:]) + "\n")
+    with pytest.raises(ValueError, match="version"):
+        read_trace(path)
+
+
+def test_trace_event_to_request_and_priority_default():
+    ev = TraceEvent(tick=2, rid="x", prompt=(1, 2, 3), max_new_tokens=4,
+                    priority="latency_critical", temperature=0.5,
+                    top_k=3, seed=9)
+    req = to_request(ev)
+    assert isinstance(req, Request)
+    assert req.priority == "latency_critical" and req.seed == 9
+    np.testing.assert_array_equal(np.asarray(req.prompt),
+                                  np.array([1, 2, 3], np.int32))
+    # a pre-traffic-class trace line (no priority key) reads as standard
+    d = ev.to_dict()
+    del d["priority"]
+    assert TraceEvent.from_dict(d).priority == "standard"
+    # arrivals grouping + its inverse round-trip
+    evs = generate_events(WorkloadConfig(seed=1, n_requests=5))
+    assert events_from_arrivals(arrivals_by_tick(evs)) == \
+        sorted(evs, key=lambda e: (e.tick, e.rid))
+
+
+def test_generator_class_mix_and_process_shapes():
+    wl = WorkloadConfig(seed=8, n_requests=40, process="poisson",
+                        class_mix={"latency_critical": 0.5,
+                                   "best_effort": 0.5})
+    evs = generate_events(wl)
+    assert len(evs) == 40
+    assert {e.priority for e in evs} <= {"latency_critical",
+                                         "best_effort"}
+    for e in evs:
+        assert wl.prompt_len_min <= len(e.prompt) <= wl.prompt_len_max
+        assert wl.max_new_min <= e.max_new_tokens <= wl.max_new_max
+    # the bursty process produces a different arrival pattern
+    mm = generate_events(WorkloadConfig(seed=8, n_requests=40,
+                                        process="mmpp"))
+    assert [e.tick for e in mm] != [e.tick for e in evs]
+    with pytest.raises(ValueError):
+        WorkloadConfig(process="weibull")
+    with pytest.raises(ValueError):
+        WorkloadConfig(class_mix={"vip": 1.0}).mix()
+
+
+# ---- runner + the autoscale/sim shim ---------------------------------------
+
+
+class _StubDriver:
+    """Records the runner's submit/tick schedule; drains after a fixed
+    number of ticks per outstanding request."""
+
+    def __init__(self):
+        self.submitted = []
+        self.ticks = 0
+        self._outstanding = 0
+
+    def submit(self, req):
+        self.submitted.append((self.ticks, req.rid))
+        self._outstanding += 1
+
+    def tick(self):
+        self.ticks += 1
+        if self._outstanding and self.ticks % 2 == 0:
+            self._outstanding -= 1
+
+    def busy(self):
+        return self._outstanding > 0
+
+
+def test_runner_and_sim_shim_drive_the_same_schedule():
+    from ray_lightning_tpu.autoscale.sim import ScriptedLoad, run_scripted
+
+    evs = generate_events(WorkloadConfig(seed=4, n_requests=6))
+    arrivals = arrivals_by_tick(evs)
+    a, b, c = _StubDriver(), _StubDriver(), _StubDriver()
+    ra = run_trace(a, arrivals, idle_ticks_after_drain=2)
+    # the runner accepts the raw event sequence too
+    rb = run_trace(b, evs, idle_ticks_after_drain=2)
+    load = ScriptedLoad(
+        arrivals={t: [to_request(e) for e in sorted(
+            g, key=lambda e: e.rid)] for t, g in
+            arrivals_by_tick(evs).items()},
+        idle_ticks_after_drain=2)
+    rc = run_scripted(c, None, load)
+    assert a.submitted == b.submitted == c.submitted
+    assert ra["submitted"] == rb["submitted"] == len(evs)
+    assert ra["ticks"] == rb["ticks"] == rc["ticks"]
+    assert ra["drained_at"] == rc["drained_at"] is not None
+
+
+# ---- SLOConfig / Request validation ----------------------------------------
+
+
+def test_sloconfig_validation_wire_and_retry_after():
+    with pytest.raises(ValueError, match="unknown class"):
+        SLOConfig(classes={"vip": ClassSLO()})
+    with pytest.raises(ValueError, match="unknown shed class"):
+        SLOConfig(shed_classes=("vip",))
+    with pytest.raises(ValueError, match="priority"):
+        Request(rid="r", prompt=np.array([1], np.int32),
+                max_new_tokens=1, priority="vip")
+    slo = SLOConfig(retry_after_base_s=0.5, retry_after_cap_s=4.0)
+    assert [slo.retry_after(n) for n in (1, 2, 3, 4, 5)] == \
+        [0.5, 1.0, 2.0, 4.0, 4.0], "hint must be capped-exponential"
+    back = SLOConfig.from_wire(slo.to_wire())
+    assert back == slo
+    assert SLOConfig.from_wire(None) is None
+
+
+def test_class_slo_rules_shapes():
+    from ray_lightning_tpu.telemetry.watch import class_slo_rules
+
+    rules = {r.name: r for r in class_slo_rules(SLOConfig())}
+    assert rules["slo_ttft_latency_critical"].severity == "page"
+    assert rules["slo_ttft_best_effort"].severity == "warn"
+    assert rules["slo_tpot_standard"].metric == \
+        "serving.tpot_standard_p95_s"
+    shed = rules["shed_best_effort"]
+    assert shed.metric == "load.sheds_best_effort"
+    assert shed.severity == "warn"
+
+
+# ---- traffic-aware scheduler seams (tiny engine) ---------------------------
+
+
+@pytest.fixture(scope="module")
+def cap1(tiny_llama_f32):
+    """A capacity-1 engine — admission order IS the completion order."""
+    import jax
+
+    from ray_lightning_tpu.serve.engine import DecodeEngine, EngineConfig
+
+    cfg, model, params, _ = tiny_llama_f32
+    eng = DecodeEngine(model, params, EngineConfig(
+        capacity=1, block_size=4, blocks_per_slot=8, prefill_chunk=4))
+    eng.warmup()
+    prompt = np.array(jax.random.randint(
+        jax.random.key(42), (1, 4), 0, cfg.vocab_size), dtype=np.int32)
+    return cfg, model, params, eng, prompt
+
+
+def _req(prompt, rid, priority, seed=0, max_new=3):
+    return Request(rid=rid, prompt=prompt[0], max_new_tokens=max_new,
+                   seed=seed, priority=priority)
+
+
+def _drain(sched):
+    out = []
+    while sched.busy():
+        out.extend(sched.tick())
+    return out
+
+
+def test_priority_off_keeps_historical_fifo(cap1):
+    """slo=None: the priority label is inert — admission stays
+    arrival-order FIFO exactly as the historical scheduler (the
+    byte-identical compatibility pin)."""
+    *_, eng, prompt = cap1
+    sched = Scheduler(eng)
+    sched.submit(_req(prompt, "a", "best_effort", seed=1))
+    sched.submit(_req(prompt, "b", "standard", seed=2))
+    sched.submit(_req(prompt, "c", "latency_critical", seed=3))
+    done = _drain(sched)
+    assert [c.rid for c in done] == ["a", "b", "c"]
+    assert [c.priority for c in done] == \
+        ["best_effort", "standard", "latency_critical"]
+    assert sched.take_sheds() == [] and sched.last_preemptions == []
+
+
+def test_class_major_admission_peer_age_order(cap1):
+    """Armed: admission is class-major (latency_critical first), FIFO
+    within a class — and a peer NEVER preempts a peer."""
+    *_, eng, prompt = cap1
+    sched = Scheduler(eng, slo=SLOConfig())
+    sched.submit(_req(prompt, "be", "best_effort", seed=1))
+    sched.submit(_req(prompt, "std", "standard", seed=2))
+    sched.submit(_req(prompt, "lc1", "latency_critical", seed=3))
+    sched.submit(_req(prompt, "lc2", "latency_critical", seed=4))
+    done = _drain(sched)
+    assert [c.rid for c in done] == ["lc1", "lc2", "std", "be"]
+    assert all(c.preempted == 0 for c in done), \
+        "no strictly-lower-class slot was running — nothing may preempt"
+
+
+def test_admit_preempt_strictly_lower_class_and_bitwise_replay(cap1):
+    """A latency-critical arrival against a full slot set preempts the
+    running best-effort slot (strictly lower class), which replays
+    bitwise — same seed, same tokens, just later."""
+    from ray_lightning_tpu.models.llama import generate
+
+    cfg, model, params, eng, prompt = cap1
+    sched = Scheduler(eng, slo=SLOConfig())
+    sched.submit(_req(prompt, "be", "best_effort", seed=7, max_new=8))
+    for _ in range(3):  # admit + prefill + first decode steps
+        sched.tick()
+    assert sched.slots, "best_effort never admitted"
+    sched.submit(_req(prompt, "lc", "latency_critical", seed=8,
+                      max_new=3))
+    done = {c.rid: c for c in _drain(sched)}
+    assert done["be"].preempted >= 1, "arrival never preempted the slot"
+    assert done["lc"].preempted == 0
+    for rid, (max_new, seed) in {"be": (8, 7), "lc": (3, 8)}.items():
+        ref = np.asarray(generate(model, params, prompt, max_new,
+                                  seed=seed))[0]
+        np.testing.assert_array_equal(np.array(done[rid].tokens), ref,
+                                      err_msg=rid)
+
+
+def test_queue_budget_shed_typed_records_and_counters(cap1):
+    """A zero best-effort budget sheds EVERY best-effort arrival at
+    enqueue with a typed record (reason, capped-exponential
+    retry_after_s) and per-class counters; other classes admit."""
+    from ray_lightning_tpu.telemetry.metrics import MetricsRegistry
+
+    *_, eng, prompt = cap1
+    slo = SLOConfig(classes={
+        "best_effort": ClassSLO(queue_budget=0)})
+    reg = MetricsRegistry()
+    sched = Scheduler(eng, metrics=reg, slo=slo)
+    sched.submit(_req(prompt, "be", "best_effort", seed=1))
+    sched.submit(_req(prompt, "lc", "latency_critical", seed=2))
+    recs = sched.take_sheds()
+    assert [r["rid"] for r in recs] == ["be"]
+    assert recs[0]["reason"] == "queue_budget"
+    assert recs[0]["priority"] == "best_effort"
+    assert recs[0]["retry_after_s"] == slo.retry_after(1) > 0
+    assert sched.take_sheds() == [], "take_sheds must drain"
+    # the resubmission's hint backs off exponentially
+    sched.submit(_req(prompt, "be", "best_effort", seed=1))
+    assert sched.take_sheds()[0]["retry_after_s"] == slo.retry_after(2)
+    done = _drain(sched)
+    assert [c.rid for c in done] == ["lc"]
+    counters = reg.counters()
+    assert counters.get("sheds") == 2
+    assert counters.get("sheds_best_effort") == 2
+
+
+def test_per_class_histograms_recorded(cap1):
+    """Armed completions land in class-keyed TTFT/TPOT histograms —
+    the surface `class_slo_rules` selectors resolve against."""
+    from ray_lightning_tpu.telemetry.metrics import MetricsRegistry
+
+    *_, eng, prompt = cap1
+    reg = MetricsRegistry()
+    sched = Scheduler(eng, metrics=reg, slo=SLOConfig())
+    sched.submit(_req(prompt, "lc", "latency_critical", seed=5))
+    sched.submit(_req(prompt, "be", "best_effort", seed=6))
+    done = _drain(sched)
+    assert len(done) == 2
+    for cls in ("latency_critical", "best_effort"):
+        for kind in ("ttft", "tpot"):
+            h = reg.histogram(f"{kind}_{cls}_s")
+            assert h is not None and h.n == 1, f"{kind}_{cls}_s"
+    assert reg.histogram("ttft_standard_s") is None
+
+
+# ---- bench + bench_gate traffic fields -------------------------------------
+
+
+def _bench_gate():
+    scripts = os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts")
+    if scripts not in sys.path:
+        sys.path.insert(0, scripts)
+    return importlib.import_module("bench_gate")
+
+
+def test_bench_gate_ratchets_lc_attainment():
+    """slo_attainment_latency_critical ratchets (measured: waived on
+    environmental skip lines; a dropped field fails)."""
+    bg = _bench_gate()
+    assert bg.RATCHETED["slo_attainment_latency_critical"] == \
+        "slo_attainment_latency_critical"
+    best = {"slo_attainment_latency_critical": (1.0, "BENCH_r09.json")}
+    ok = {"metric": "m", "value": 1.0,
+          "slo_attainment_latency_critical": 1.0}
+    assert bg.gate(ok, best, tolerance=0.05) == []
+    worse = {"metric": "m", "value": 1.0,
+             "slo_attainment_latency_critical": 0.5}
+    assert any("slo_attainment_latency_critical" in f
+               for f in bg.gate(worse, best, tolerance=0.05))
+    skip = {"metric": "m", "value": 0.0,
+            "skipped": "backend unavailable"}
+    assert bg.gate(skip, best, tolerance=0.05) == []
+    dropped = {"metric": "m", "value": 1.0}
+    assert any("dropped the field" in f
+               for f in bg.gate(dropped, best, tolerance=0.05))
+
+
+def test_bench_serve_summary_carries_traffic_schema():
+    """The static serving schema (carried even on backend-down skip
+    lines) names the traffic-class fields the measured leg emits."""
+    import bench
+
+    s = bench._serve_summary()["serving"]
+    for field in ("slo_attainment", "slo_attainment_latency_critical",
+                  "shed_fraction"):
+        assert field in s["schema"], field
+        assert field in s["traffic_schema"] or \
+            field == "slo_attainment_latency_critical"
+    assert set(s["traffic_schema"]) == {
+        "slo_attainment", "slo_attainment_latency_critical",
+        "shed_fraction"}
+
+
+# ---- slow: process-backend SIGKILL drill -----------------------------------
+
+
+@pytest.mark.slow
+def test_process_kill_mid_burst_replays_with_class_accounting(
+        tiny_llama_f32, tmp_path):
+    """A seeded mixed-class burst on a real process replica with a
+    mid-burst SIGKILL: the respawn replays the lost streams bitwise,
+    the zero-budget best-effort shed set stays exactly the best-effort
+    arrivals, and the per-class accounting is consistent across the
+    channel epoch roll — a dead epoch's shed records must not
+    double-count the driver's shed counter."""
+    import jax
+
+    from ray_lightning_tpu.models.llama import generate
+    from ray_lightning_tpu.serve.channel import channel_dir
+    from ray_lightning_tpu.serve.driver import (
+        ReplicaGroupConfig,
+        ServeDriver,
+        save_params_npz,
+    )
+    from ray_lightning_tpu.serve.engine import EngineConfig
+
+    cfg, model, params, _ = tiny_llama_f32
+    rng = np.random.Generator(np.random.PCG64(55))
+    classes = ["latency_critical", "standard", "best_effort",
+               "standard", "best_effort", "latency_critical"]
+    reqs = [Request(
+        rid=f"k{i:02d}",
+        prompt=np.asarray(rng.integers(0, cfg.vocab_size,
+                                       size=3 + i % 3), np.int32),
+        max_new_tokens=8, temperature=0.7 if i % 2 else 0.0,
+        top_k=4 if i % 2 else None, seed=61 + i,
+        priority=classes[i]) for i in range(len(classes))]
+    slo = SLOConfig(classes={"best_effort": ClassSLO(queue_budget=0)})
+    be_rids = sorted(r.rid for r in reqs
+                     if r.priority == "best_effort")
+    refs = {r.rid: np.asarray(generate(
+        model, params, np.asarray(r.prompt)[None, :],
+        r.max_new_tokens, temperature=r.temperature, top_k=r.top_k,
+        seed=r.seed))[0] for r in reqs if r.rid not in be_rids}
+    pp = str(tmp_path / "params.npz")
+    save_params_npz(params, pp)
+    drv = ServeDriver(cfg, pp, ReplicaGroupConfig(
+        n_replicas=1, backend="process",
+        engine=EngineConfig(capacity=2, block_size=4,
+                            blocks_per_slot=8, prefill_chunk=4),
+        run_dir=str(tmp_path / "run"),
+        compile_cache_dir=str(tmp_path / "cc"),
+        platform="cpu", cpu_devices_per_rank=1,
+        env={"JAX_PLATFORMS": "cpu"}, max_restarts=2,
+        metrics_flush_every_n_ticks=2, slo=slo))
+    drv.start(fault={"replica": 0, "kill_after_tokens": 10})
+    for r in reqs:
+        drv.submit(r)
+    while drv.busy():
+        drv.tick()
+        time.sleep(0.01)
+    res = drv.stop()
+    assert res.restarts[0] >= 1, "kill never triggered a respawn"
+    # bitwise replay of every surviving stream
+    for rid, ref in refs.items():
+        np.testing.assert_array_equal(np.array(res.outputs[rid]), ref,
+                                      err_msg=rid)
+    # typed sheds: exactly the best-effort arrivals, once each
+    shed_meta = sorted(r for r, m in res.meta.items()
+                       if m.get("finish_reason") == "shed")
+    assert shed_meta == be_rids
+    assert res.stats.get("requests_shed") == len(be_rids), \
+        "epoch-roll replay double-counted (or dropped) shed records"
+    # zero silent drops: every rid has a terminal meta record
+    assert sorted(res.meta) == sorted(r.rid for r in reqs)
+    for rid, m in res.meta.items():
+        cls = m.get("priority", "standard")
+        want = next(r.priority for r in reqs if r.rid == rid)
+        assert cls == want, f"{rid}: class lost across the channel"
+        if rid in be_rids:
+            assert m.get("retry_after_s", 0) > 0
+        else:
+            assert m.get("finish_reason") in ("eos", "length")
+    # the respawn rolled the command log to a fresh epoch
+    epochs = sorted(p.name for p in
+                    channel_dir(str(tmp_path / "run"), 0).iterdir())
+    assert "epoch1.jsonl" in epochs
+    assert res.stats["compile_count"] in (1, -1)

@@ -405,7 +405,9 @@ def test_bench_serve_summary_static():
         "ttft_cold_s", "ttft_warm_s", "ttft_p99_s", "slot_occupancy",
         "shared_block_fraction", "accepted_tokens_per_step",
         "serving_attention_path", "serving_prefill_path",
-        "serve_metrics", "scale_up_s", "autoscale"}
+        "serve_metrics", "scale_up_s", "autoscale",
+        "slo_attainment", "slo_attainment_latency_critical",
+        "shed_fraction"}
     # the ISSUE 19 static pricing blocks ride every line
     assert s["serving"]["prefix_plan"]["shared_pool_bytes_saved"] > 0
     assert s["serving"]["prefix_plan"]["prefill_tokens_saved"] > 0
